@@ -1,0 +1,25 @@
+"""t2omca_tpu — a TPU-native multi-agent RL framework.
+
+A brand-new JAX/XLA implementation of the capabilities of hj5717/T2OMCA
+(a QMIX-family multi-agent RL system with transformer agents and a
+transformer mixing network trained on a multi-AGV/MEC task-offloading
+environment). Instead of the reference's subprocess-per-environment
+rollout (`/root/reference/parallel_runner.py`) and single-device PyTorch
+learner (`/root/reference/per_run.py`), everything here — environment,
+rollout, replay, train step — is a pure function on pytrees composed with
+`jax.vmap` (env batch), `jax.lax.scan` (episode time) and `jax.sharding`
+meshes (data parallelism over ICI).
+
+Package map:
+  envs/         pure-functional MultiAgvOffloading environment + registry
+  models/       flax modules: Transformer core, TransformerAgent, TransformerMixer
+  controllers/  multi-agent controller (MAC) + action selectors
+  learners/     QMIX TD learner (scan-over-time, double-Q, PER weights)
+  runners/      vmapped rollout runner + single-env episode runner
+  replay/       episode batch pytree + uniform & prioritized replay (device-resident)
+  parallel/     mesh construction, sharded train step, ring attention (SP extension)
+  ops/          pallas kernels (opt-in fused attention)
+  utils/        logging, time helpers, schedules, checkpointing
+"""
+
+__version__ = "0.1.0"

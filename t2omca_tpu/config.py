@@ -1,0 +1,244 @@
+"""Configuration system.
+
+Replaces the reference's sacred config dict → ``SimpleNamespace`` flow
+(``/root/reference/per_run.py:20-66,292-309``). The full flag inventory is the
+set of ``args.*`` / ``config[...]`` accesses in the released reference slice
+(SURVEY.md §5.6); every one of those flags exists here with the same name so a
+reference user can carry their config across.
+
+Config objects are frozen dataclasses (hashable → usable as jit static
+arguments). ``load_config`` merges: defaults → optional YAML/JSON file →
+``key=value`` CLI overrides, then runs the same sanity pass the reference
+applies in ``args_sanity_check`` (``/root/reference/per_run.py:292-309``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class EnvConfig:
+    """Environment flags (reference ``env_args``, SURVEY.md §5.6)."""
+
+    key: str = "multi_agv_offloading"     # env registry name (ref: env / map_name)
+    map_name: str = "multi_agv"
+    seed: int = 0
+    mec_num: int = 2
+    agv_num: int = 4
+    num_channels: int = 2
+    episode_limit: int = 150
+    obs_entity_mode: bool = True
+    state_entity_mode: bool = True
+    state_last_action: bool = False
+    edge_only: bool = False
+
+    # ----- physics / M1 spec values (frozen in docs/SPEC.md §1; the reference
+    # does not release data_struct_multiagv, so these are our pinned choices)
+    mec_radius_m: float = 50.0            # placement radius & spacing/2 (ref env :23-24)
+    communication_range_m: float = 50.0   # MEC.communication_range (M1)
+    mec_compute_cap: float = 20e9         # cycles/s (M1)
+    user_compute_cap: float = 5e9         # cycles/s (M1)
+    transmit_power_w: float = 0.5         # W (M1)
+    latency_max_ms: float = 100.0         # job deadline budget (M1)
+    job_prob: float = 0.5                 # P(generate_job emits a job) per slot (M1)
+    data_size_min: float = 4000.0         # bits (M1)
+    data_size_max: float = 12000.0        # bits (M1)
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Agent/mixer model flags (SURVEY.md §5.6 'model')."""
+
+    emb: int = 32
+    heads: int = 3
+    depth: int = 2
+    ff_hidden_mult: int = 4
+    dropout: float = 0.0
+    mixer_emb: int = 32                   # must equal emb when mixer consumes agent hiddens
+    mixer_heads: int = 3
+    mixer_depth: int = 2
+    qmix_pos_func: str = "abs"            # abs | softplus | quadratic | none
+    qmix_pos_func_beta: float = 1.0
+    use_orthogonal: bool = False
+    standard_heads: bool = False          # perf mode: per-head dim = emb//heads (quirk Q1 off)
+    # entity counts: filled from env info when 0
+    n_entities_obs: int = 0
+    n_entities_state: int = 0
+
+
+@dataclass(frozen=True)
+class ReplayConfig:
+    buffer_size: int = 500                # episodes
+    buffer_cpu_only: bool = False         # kept for parity; device-resident by default
+    prioritized: bool = True
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Top-level run flags (reference run-control set, SURVEY.md §5.6)."""
+
+    name: str = "qmix_transf"
+    seed: int = 0
+    t_max: int = 205_000
+    test_interval: int = 10_000
+    test_nepisode: int = 32
+    log_interval: int = 10_000
+    runner_log_interval: int = 10_000
+    batch_size_run: int = 8               # parallel envs (vmapped, not subprocesses)
+    batch_size: int = 32                  # train batch (episodes)
+    accumulated_episodes: int = 0         # min episodes collected before training
+    use_cuda: bool = False                # parity flag; device selection is JAX's
+    evaluate: bool = False
+    checkpoint_path: str = ""
+    load_step: int = 0
+    save_model: bool = True
+    save_model_interval: int = 50_000
+    local_results_path: str = "results"
+    use_tensorboard: bool = False
+    save_replay: bool = False
+    save_animation: bool = False
+    animation_interval: int = 200_000
+    animation_interval_evaluation: int = 0
+
+    # component selection (registries, reference §5.6)
+    runner: str = "parallel"
+    mac: str = "basic_mac"
+    learner: str = "qmix_learner"
+    env: str = "multi_agv_offloading"
+
+    # learning hyperparameters (M8 spec — pinned from the PyMARL/TransfQMIX
+    # lineage the reference forks; the learner itself is unreleased)
+    gamma: float = 0.99
+    lr: float = 0.001
+    optimizer: str = "adam"               # adam | rmsprop
+    optim_alpha: float = 0.99             # rmsprop smoothing
+    optim_eps: float = 1e-5
+    grad_norm_clip: float = 10.0
+    target_update_interval: int = 200     # episodes between hard target syncs
+    double_q: bool = True
+
+    # action selection
+    action_selector: str = "epsilon_greedy"   # epsilon_greedy | noisy-new
+    epsilon_start: float = 1.0
+    epsilon_finish: float = 0.05
+    epsilon_anneal_time: int = 50_000
+
+    env_args: EnvConfig = field(default_factory=EnvConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    replay: ReplayConfig = field(default_factory=ReplayConfig)
+
+    def replace(self, **kw) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def sanity_check(cfg: TrainConfig) -> TrainConfig:
+    """Mirror of the reference ``args_sanity_check``
+    (``/root/reference/per_run.py:292-309``): round ``test_nepisode`` down to a
+    multiple of ``batch_size_run`` (quirk Q10)."""
+    tn = cfg.test_nepisode
+    if tn < cfg.batch_size_run:
+        tn = cfg.batch_size_run
+    else:
+        tn = (tn // cfg.batch_size_run) * cfg.batch_size_run
+    if cfg.model.standard_heads:
+        if cfg.model.emb % cfg.model.heads or cfg.model.mixer_emb % cfg.model.mixer_heads:
+            raise ValueError(
+                f"standard_heads requires emb divisible by heads: got "
+                f"emb={cfg.model.emb}/heads={cfg.model.heads}, "
+                f"mixer_emb={cfg.model.mixer_emb}/mixer_heads={cfg.model.mixer_heads}."
+            )
+    if cfg.model.mixer_emb != cfg.model.emb:
+        raise ValueError(
+            "mixer_emb must equal emb: the mixer concatenates agent hidden "
+            "tokens (dim emb) with its own embeddings (dim mixer_emb) "
+            "(reference n_transf_mixer.py:69)."
+        )
+    return cfg.replace(test_nepisode=tn)
+
+
+def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
+    """Merge a (possibly nested) dict of overrides into the config tree."""
+    env_kw = dict(updates.pop("env_args", {}) or {})
+    model_kw = dict(updates.pop("model", {}) or {})
+    replay_kw = dict(updates.pop("replay", {}) or {})
+
+    # route flat keys to their sub-config for reference-style flat configs
+    env_fields = {f.name for f in dataclasses.fields(EnvConfig)}
+    model_fields = {f.name for f in dataclasses.fields(ModelConfig)}
+    replay_fields = {f.name for f in dataclasses.fields(ReplayConfig)}
+    top_fields = {f.name for f in dataclasses.fields(TrainConfig)}
+    flat = dict(updates)
+    for k, v in flat.items():
+        if k in top_fields:
+            continue
+        if k in model_fields:
+            model_kw.setdefault(k, v)
+            updates.pop(k)
+        elif k in replay_fields:
+            replay_kw.setdefault(k, v)
+            updates.pop(k)
+        elif k in env_fields:
+            env_kw.setdefault(k, v)
+            updates.pop(k)
+        else:
+            raise KeyError(f"unknown config key: {k}")
+
+    if env_kw:
+        updates["env_args"] = dataclasses.replace(cfg.env_args, **env_kw)
+    if model_kw:
+        updates["model"] = dataclasses.replace(cfg.model, **model_kw)
+    if replay_kw:
+        updates["replay"] = dataclasses.replace(cfg.replay, **replay_kw)
+    return cfg.replace(**updates)
+
+
+def _coerce(s: str) -> Any:
+    if s.lower() in ("true", "false"):
+        return s.lower() == "true"
+    for cast in (int, float):
+        try:
+            return cast(s)
+        except ValueError:
+            pass
+    return s
+
+
+def load_config(path: Optional[str] = None,
+                overrides: Tuple[str, ...] = ()) -> TrainConfig:
+    """defaults → file → ``key=value`` / ``section.key=value`` overrides."""
+    cfg = TrainConfig()
+    if path:
+        with open(path) as f:
+            if path.endswith((".yaml", ".yml")):
+                import yaml  # baked into the image via other deps; gated import
+                data = yaml.safe_load(f)
+            else:
+                data = json.load(f)
+        cfg = _merge_nested(cfg, data or {})
+    updates: dict = {}
+    for ov in overrides:
+        k, _, v = ov.partition("=")
+        val = _coerce(v)
+        if "." in k:
+            sec, sub = k.split(".", 1)
+            updates.setdefault(sec, {})[sub] = val
+        else:
+            updates[k] = val
+    cfg = _merge_nested(cfg, updates)
+    return sanity_check(cfg)
+
+
+def unique_token(cfg: TrainConfig) -> str:
+    """Run-naming scheme of the reference (``/root/reference/per_run.py:42``):
+    ``{name}_seed{seed}_{map}_{datetime}``."""
+    import datetime
+
+    ts = datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
+    return f"{cfg.name}_seed{cfg.seed}_{cfg.env_args.map_name}_{ts}"

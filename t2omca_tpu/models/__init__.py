@@ -1,0 +1,13 @@
+from .agent import TransformerAgent
+from .mixer import TransformerMixer
+from .noisy import NoisyLinear
+from .transformer import MultiHeadAttention, Transformer, TransformerBlock
+
+__all__ = [
+    "MultiHeadAttention",
+    "Transformer",
+    "TransformerBlock",
+    "TransformerAgent",
+    "TransformerMixer",
+    "NoisyLinear",
+]

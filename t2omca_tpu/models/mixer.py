@@ -1,0 +1,107 @@
+"""Transformer QMIX mixer.
+
+Re-creates ``TransformerMixer`` (``/root/reference/n_transf_mixer.py:12-103``):
+the QMIX hypernetwork weights are *read off positional output tokens* of a
+transformer run over [state-entity embeddings ++ agent hidden states ++ 3
+recurrent "hyper" tokens] (quirk Q11 — the concatenation order is
+load-bearing):
+
+    w1 = tokens[-3-n_agents:-3]   (one per agent)
+    b1 = tokens[-3]
+    w2 = tokens[-2]
+    b2 = relu(hyper_b2(tokens[-1]))
+
+Monotonicity in the per-agent Qs is enforced by ``pos_func`` on w1/w2
+(``n_transf_mixer.py:84-85,95-103``), then
+``q_tot = elu(q·w1 + b1)·w2 + b2``. The mixer returns its last 3 output
+tokens so the learner can carry them recurrently across timesteps
+(``n_transf_mixer.py:91``).
+
+Quirk Q12: when ``state_entity_mode`` is false the mixer tokenizes *all
+agents' observation entities* instead of state entities
+(``n_transf_mixer.py:43,60-63``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .transformer import Transformer, orthogonal_or_default
+
+
+class TransformerMixer(nn.Module):
+    n_agents: int
+    n_entities: int            # n_entities_state override, else n_entities
+    feat_dim: int              # state_entity_feats
+    emb: int                   # mixer_emb == agent emb (hidden tokens concat)
+    heads: int
+    depth: int
+    ff_hidden_mult: int = 4
+    dropout: float = 0.0
+    qmix_pos_func: str = "abs"
+    qmix_pos_func_beta: float = 1.0
+    state_entity_mode: bool = True
+    standard_heads: bool = False
+    use_orthogonal: bool = False
+
+    def pos_func(self, x: jax.Array) -> jax.Array:
+        if self.qmix_pos_func == "softplus":
+            b = self.qmix_pos_func_beta
+            return jax.nn.softplus(b * x) / b
+        if self.qmix_pos_func == "quadratic":
+            return 0.5 * x ** 2
+        if self.qmix_pos_func == "abs":
+            return jnp.abs(x)
+        return x
+
+    @nn.compact
+    def __call__(self, qvals: jax.Array, hidden_states: jax.Array,
+                 hyper_weights: jax.Array, states: jax.Array,
+                 obs: jax.Array, deterministic: bool = True,
+                 ) -> Tuple[jax.Array, jax.Array]:
+        """qvals ``(b, 1, n_agents)``; hidden_states ``(b, n_agents, emb)``;
+        hyper_weights ``(b, 3, emb)``; returns ``(q_tot (b,1,1), hyper (b,3,emb))``."""
+        b = qvals.shape[0]
+
+        if self.state_entity_mode:
+            inputs = states.reshape(b, self.n_entities, self.feat_dim)
+        else:  # Q12: all agents' obs entities
+            inputs = obs.reshape(b, self.n_agents * self.n_entities, self.feat_dim)
+
+        embs = nn.Dense(self.emb, name="feat_embedding",
+                        kernel_init=orthogonal_or_default(self.use_orthogonal))(inputs)
+
+        tokens = jnp.concatenate([embs, hidden_states, hyper_weights], axis=1)
+
+        out = Transformer(
+            emb=self.emb, heads=self.heads, depth=self.depth,
+            ff_hidden_mult=self.ff_hidden_mult, dropout=self.dropout,
+            standard_heads=self.standard_heads,
+            use_orthogonal=self.use_orthogonal,
+            name="transformer")(tokens, tokens, deterministic=deterministic)
+
+        w1 = out[:, -3 - self.n_agents:-3, :]                  # (b, A, emb)
+        b1 = out[:, -3, :].reshape(b, 1, self.emb)
+        w2 = out[:, -2, :].reshape(b, self.emb, 1)
+        b2 = nn.relu(
+            nn.Dense(1, name="hyper_b2",
+                     kernel_init=orthogonal_or_default(self.use_orthogonal))(
+                out[:, -1, :])).reshape(b, 1, 1)
+
+        w1 = self.pos_func(w1)
+        w2 = self.pos_func(w2)
+
+        hidden = nn.elu(jnp.matmul(qvals, w1) + b1)            # (b, 1, emb)
+        y = jnp.matmul(hidden, w2) + b2                        # (b, 1, 1)
+        return y, out[:, -3:, :]
+
+    def initial_hyper(self, batch_size: int) -> jax.Array:
+        """Zeros ``(batch, 3, emb)``; the reference's ``init_hidden`` returns
+        zeros ``(1, n_agents, emb)`` (``n_transf_mixer.py:52-53``) but the
+        consumed shape at ``forward`` is the 3 hyper tokens — we expose the
+        consumed shape directly."""
+        return jnp.zeros((batch_size, 3, self.emb))

@@ -1,0 +1,62 @@
+"""Factorized-Gaussian NoisyNet linear layer (M11).
+
+The reference imports ``utils.noisy_liner.NoisyLinear`` for its
+exploration-by-parameter-noise mode (``/root/reference/transf_agent.py:6,37-39``,
+selected by ``action_selector == "noisy-new"``); the module itself is not
+released, so this follows the standard NoisyNet formulation (Fortunato et al.
+2018, factorized Gaussian):
+
+    w = mu_w + sigma_w * (f(eps_out) ⊗ f(eps_in)),  f(x) = sign(x)*sqrt(|x|)
+    b = mu_b + sigma_b * f(eps_out)
+
+Noise is drawn from the flax ``"noise"`` RNG stream; with
+``deterministic=True`` (evaluation) only the mean parameters are used.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def _scaled_noise(key: jax.Array, n: int) -> jax.Array:
+    x = jax.random.normal(key, (n,))
+    return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
+
+
+class NoisyLinear(nn.Module):
+    features: int
+    use_bias: bool = True
+    sigma0: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        in_dim = x.shape[-1]
+        bound = in_dim ** -0.5
+        mu_init = nn.initializers.uniform(scale=2 * bound)  # ~U(0, 2/sqrt(in))
+        sigma_init = nn.initializers.constant(self.sigma0 * bound)
+
+        w_mu = self.param("w_mu", lambda k, s: mu_init(k, s) - bound,
+                          (in_dim, self.features))
+        w_sigma = self.param("w_sigma", sigma_init, (in_dim, self.features))
+        if self.use_bias:
+            b_mu = self.param("b_mu", lambda k, s: mu_init(k, s) - bound,
+                              (self.features,))
+            b_sigma = self.param("b_sigma", sigma_init, (self.features,))
+
+        if deterministic:
+            w = w_mu
+            b = b_mu if self.use_bias else None
+        else:
+            key = self.make_rng("noise")
+            k_in, k_out = jax.random.split(key)
+            eps_in = _scaled_noise(k_in, in_dim)
+            eps_out = _scaled_noise(k_out, self.features)
+            w = w_mu + w_sigma * jnp.outer(eps_in, eps_out)
+            b = (b_mu + b_sigma * eps_out) if self.use_bias else None
+
+        y = x @ w
+        if b is not None:
+            y = y + b
+        return y

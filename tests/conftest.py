@@ -1,0 +1,20 @@
+"""Test configuration: force the JAX CPU backend with 8 virtual devices.
+
+SURVEY.md §4: multi-chip paths are tested without a cluster via
+``xla_force_host_platform_device_count``. The axon sitecustomize registers a
+TPU backend whenever ``PALLAS_AXON_POOL_IPS`` is set, so it is cleared before
+anything imports jax.
+"""
+
+import os
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")

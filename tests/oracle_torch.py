@@ -1,0 +1,104 @@
+"""PyTorch oracle for golden-value parity tests.
+
+A compact, independent transcription of the reference math (SURVEY.md §2.1
+C5–C7; quirks Q1/Q2/Q11/Q12) used only by tests: we load identical weights
+into both this oracle and the flax modules and require matching outputs.
+This is the "pinned oracle" strategy of SURVEY.md §7.4(2) — the learner and
+several reference modules were never released, so parity is defined against
+this spec, not against running the reference.
+
+Functional style on purpose (no nn.Module graph): takes a flat dict of
+tensors whose keys mirror the flax param tree.
+"""
+
+from __future__ import annotations
+
+import math
+
+import torch
+import torch.nn.functional as F
+
+
+def mha(p, prefix, q, k, heads):
+    """Full-emb-head attention: projections emb->emb*heads, q/k scaled by
+    head_dim**-0.25 (quirk Q1)."""
+    b, t_q, e = q.shape
+    t_k = k.shape[1]
+    kk = k @ p[f"{prefix}/tokeys"]            # (b, t_k, h*e)
+    qq = q @ p[f"{prefix}/toqueries"]
+    vv = k @ p[f"{prefix}/tovalues"]
+    kk = kk.view(b, t_k, heads, e).transpose(1, 2) / e ** 0.25
+    qq = qq.view(b, t_q, heads, e).transpose(1, 2) / e ** 0.25
+    vv = vv.view(b, t_k, heads, e).transpose(1, 2)
+    dot = qq @ kk.transpose(-1, -2)
+    attn = F.softmax(dot, dim=-1)
+    out = (attn @ vv).transpose(1, 2).reshape(b, t_q, heads * e)
+    return out @ p[f"{prefix}/unifyheads"] + p[f"{prefix}/unifyheads_b"]
+
+
+def layer_norm(p, prefix, x):
+    return F.layer_norm(x, (x.shape[-1],), p[f"{prefix}/scale"], p[f"{prefix}/bias"])
+
+
+def block(p, prefix, q, k, heads):
+    """Post-LN block, residual adds the query input (quirk Q2)."""
+    att = mha(p, f"{prefix}/attention", q, k, heads)
+    x = layer_norm(p, f"{prefix}/norm1", att + q)
+    ff = F.relu(x @ p[f"{prefix}/ff1"] + p[f"{prefix}/ff1_b"])
+    ff = ff @ p[f"{prefix}/ff2"] + p[f"{prefix}/ff2_b"]
+    return layer_norm(p, f"{prefix}/norm2", ff + x)
+
+
+def transformer(p, prefix, q, k, heads, depth):
+    """Keys pinned to the layer-0 input across blocks (reference
+    transformer.py:126,140 tuple threading)."""
+    x = q
+    for i in range(depth):
+        x = block(p, f"{prefix}/block_{i}", x, k, heads)
+    return x
+
+
+def agent_forward(p, inputs, hidden, *, n_entities, feat_dim, emb, heads, depth):
+    """TransformerAgent: hidden token prepended, token 0 out (C6)."""
+    b, a, _ = inputs.shape
+    x = inputs.reshape(b * a, n_entities, feat_dim)
+    h = hidden.reshape(b * a, 1, emb)
+    embs = x @ p["feat_embedding"] + p["feat_embedding_b"]
+    tokens = torch.cat([h, embs], dim=1)
+    out = transformer(p, "transformer", tokens, tokens, heads, depth)
+    h_new = out[:, 0:1, :]
+    qv = h_new @ p["q_basic"] + p["q_basic_b"]
+    return qv.reshape(b, a, -1), h_new.reshape(b, a, emb)
+
+
+def mixer_forward(p, qvals, hidden_states, hyper_weights, states, obs, *,
+                  n_agents, n_entities, feat_dim, emb, heads, depth,
+                  state_entity_mode=True, pos="abs", pos_beta=1.0):
+    """TransformerMixer: hypernet weights read off positional tokens (C7/Q11)."""
+    b = qvals.shape[0]
+    if state_entity_mode:
+        inp = states.reshape(b, n_entities, feat_dim)
+    else:
+        inp = obs.reshape(b, n_agents * n_entities, feat_dim)
+    embs = inp @ p["feat_embedding"] + p["feat_embedding_b"]
+    tokens = torch.cat([embs, hidden_states, hyper_weights], dim=1)
+    out = transformer(p, "transformer", tokens, tokens, heads, depth)
+    w1 = out[:, -3 - n_agents:-3, :]
+    b1 = out[:, -3, :].view(b, 1, emb)
+    w2 = out[:, -2, :].view(b, emb, 1)
+    b2 = F.relu(out[:, -1, :] @ p["hyper_b2"] + p["hyper_b2_b"]).view(b, 1, 1)
+
+    def pos_fn(x):
+        if pos == "softplus":
+            # torch.nn.Softplus(beta=b) == softplus(b*x)/b
+            return F.softplus(x, beta=pos_beta)
+        if pos == "quadratic":
+            return 0.5 * x ** 2
+        if pos == "abs":
+            return torch.abs(x)
+        return x
+
+    w1, w2 = pos_fn(w1), pos_fn(w2)
+    hid = F.elu(qvals @ w1 + b1)
+    y = hid @ w2 + b2
+    return y, out[:, -3:, :]

@@ -1,0 +1,170 @@
+"""Golden-value parity: flax modules vs the independent PyTorch oracle.
+
+Identical weights are loaded into both implementations; outputs must agree to
+fp32 tolerance. This pins quirks Q1 (full-emb heads, e**1/4 scaling), Q2
+(post-LN / query residual), the layer-0 key threading, C6's hidden-token
+recurrence, and C7's positional hypernet reads + monotonicity funcs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from t2omca_tpu.models import Transformer, TransformerAgent, TransformerMixer
+
+import oracle_torch as oracle
+
+
+def to_torch_params(flax_params):
+    """Flatten a flax param tree into the oracle's flat dict naming."""
+    flat = {}
+
+    def rec(prefix, tree):
+        keys = set(tree.keys())
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                rec(prefix + [k], v)
+            else:
+                name = "/".join(prefix)
+                arr = torch.tensor(np.asarray(v))
+                if k == "kernel":
+                    flat[name] = arr
+                elif k == "scale":
+                    flat[name + "/scale"] = arr
+                elif k == "bias" and "scale" in keys:
+                    flat[name + "/bias"] = arr
+                elif k == "bias":
+                    flat[name + "_b"] = arr
+                else:
+                    raise KeyError(k)
+
+    rec([], jax.tree.map(lambda x: x, flax_params))
+    return flat
+
+
+def assert_close(jx, tx, atol=2e-5):
+    np.testing.assert_allclose(np.asarray(jx), tx.detach().numpy(),
+                               atol=atol, rtol=1e-4)
+
+
+@pytest.mark.parametrize("heads,depth", [(1, 1), (3, 2)])
+def test_transformer_core_parity(heads, depth):
+    emb, t, b = 8, 5, 4
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (b, t, emb))
+    model = Transformer(emb=emb, heads=heads, depth=depth)
+    params = model.init(jax.random.PRNGKey(1), x, x)["params"]
+    out_j = model.apply({"params": params}, x, x)
+
+    # oracle.transformer prefixes keys with "{prefix}/"; alias under "x/"
+    tp2 = {("x/" + k): v for k, v in to_torch_params(params).items()}
+    xt = torch.tensor(np.asarray(x))
+    out_t = oracle.transformer(tp2, "x", xt, xt, heads, depth)
+    assert_close(out_j, out_t)
+
+
+def test_depth2_keys_are_layer0_input():
+    """The second block must attend against the ORIGINAL input keys
+    (reference transformer.py:126,140 tuple threading), not block-1 output."""
+    emb, t, b, heads = 8, 4, 2, 2
+    x = jax.random.normal(jax.random.PRNGKey(2), (b, t, emb))
+    model = Transformer(emb=emb, heads=heads, depth=2)
+    params = model.init(jax.random.PRNGKey(3), x, x)["params"]
+    out = model.apply({"params": params}, x, x)
+
+    # manual: block0(x, x) then block1(y, x)  — NOT block1(y, y)
+    from t2omca_tpu.models.transformer import TransformerBlock
+    blk = TransformerBlock(emb=emb, heads=heads)
+    y = blk.apply({"params": params["block_0"]}, x, x)
+    z_correct = blk.apply({"params": params["block_1"]}, y, x)
+    z_wrong = blk.apply({"params": params["block_1"]}, y, y)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(z_correct), atol=1e-6)
+    assert not np.allclose(np.asarray(out), np.asarray(z_wrong), atol=1e-4)
+
+
+def test_agent_parity():
+    b, a, n_entities, feat, emb, heads, depth, n_actions = 3, 4, 4, 9, 8, 2, 2, 5
+    model = TransformerAgent(n_agents=a, n_entities=n_entities, feat_dim=feat,
+                             emb=emb, heads=heads, depth=depth,
+                             n_actions=n_actions)
+    obs = jax.random.normal(jax.random.PRNGKey(4), (b, a, n_entities * feat))
+    hid = jax.random.normal(jax.random.PRNGKey(5), (b, a, emb))
+    params = model.init(jax.random.PRNGKey(6), obs, hid)["params"]
+    q_j, h_j = model.apply({"params": params}, obs, hid)
+    assert q_j.shape == (b, a, n_actions) and h_j.shape == (b, a, emb)
+
+    tp = to_torch_params(params)
+    q_t, h_t = oracle.agent_forward(
+        tp, torch.tensor(np.asarray(obs)), torch.tensor(np.asarray(hid)),
+        n_entities=n_entities, feat_dim=feat, emb=emb, heads=heads, depth=depth)
+    assert_close(q_j, q_t)
+    assert_close(h_j, h_t)
+
+
+@pytest.mark.parametrize("pos,pos_beta", [("abs", 1.0), ("quadratic", 1.0),
+                                          ("none", 1.0), ("softplus", 1.0),
+                                          ("softplus", 2.5)])
+def test_mixer_parity(pos, pos_beta):
+    b, a, n_entities, feat, emb, heads, depth = 3, 4, 4, 8, 8, 2, 1
+    model = TransformerMixer(n_agents=a, n_entities=n_entities, feat_dim=feat,
+                             emb=emb, heads=heads, depth=depth,
+                             qmix_pos_func=pos, qmix_pos_func_beta=pos_beta)
+    qvals = jax.random.normal(jax.random.PRNGKey(7), (b, 1, a))
+    hidden = jax.random.normal(jax.random.PRNGKey(8), (b, a, emb))
+    hyper = jax.random.normal(jax.random.PRNGKey(9), (b, 3, emb))
+    states = jax.random.normal(jax.random.PRNGKey(10), (b, n_entities * feat))
+    obs = jnp.zeros((b, a, n_entities * feat))
+    params = model.init(jax.random.PRNGKey(11), qvals, hidden, hyper,
+                        states, obs)["params"]
+    y_j, hw_j = model.apply({"params": params}, qvals, hidden, hyper, states, obs)
+    assert y_j.shape == (b, 1, 1) and hw_j.shape == (b, 3, emb)
+
+    tp = to_torch_params(params)
+    y_t, hw_t = oracle.mixer_forward(
+        tp, torch.tensor(np.asarray(qvals)), torch.tensor(np.asarray(hidden)),
+        torch.tensor(np.asarray(hyper)), torch.tensor(np.asarray(states)),
+        torch.tensor(np.asarray(obs)), n_agents=a, n_entities=n_entities,
+        feat_dim=feat, emb=emb, heads=heads, depth=depth, pos=pos,
+        pos_beta=pos_beta)
+    assert_close(y_j, y_t)
+    assert_close(hw_j, hw_t)
+
+
+def test_mixer_monotone_in_qvals():
+    """q_tot must be monotonically non-decreasing in every agent's Q (QMIX
+    constraint via pos_func on w1/w2, n_transf_mixer.py:84-89)."""
+    b, a, n_entities, feat, emb = 2, 3, 3, 8, 8
+    model = TransformerMixer(n_agents=a, n_entities=n_entities, feat_dim=feat,
+                             emb=emb, heads=2, depth=1)
+    qvals = jax.random.normal(jax.random.PRNGKey(12), (b, 1, a))
+    hidden = jax.random.normal(jax.random.PRNGKey(13), (b, a, emb))
+    hyper = jax.random.normal(jax.random.PRNGKey(14), (b, 3, emb))
+    states = jax.random.normal(jax.random.PRNGKey(15), (b, n_entities * feat))
+    obs = jnp.zeros((b, a, n_entities * feat))
+    params = model.init(jax.random.PRNGKey(16), qvals, hidden, hyper,
+                        states, obs)["params"]
+
+    def qtot(qv):
+        y, _ = model.apply({"params": params}, qv, hidden, hyper, states, obs)
+        return y.sum()
+
+    grad = jax.grad(qtot)(qvals)
+    assert np.all(np.asarray(grad) >= 0), "mixer not monotone in agent Qs"
+
+
+def test_agent_noisy_mode():
+    b, a, n_entities, feat, emb = 2, 3, 3, 9, 8
+    model = TransformerAgent(n_agents=a, n_entities=n_entities, feat_dim=feat,
+                             emb=emb, heads=2, depth=1, n_actions=4, noisy=True)
+    obs = jax.random.normal(jax.random.PRNGKey(17), (b, a, n_entities * feat))
+    hid = jnp.zeros((b, a, emb))
+    params = model.init(jax.random.PRNGKey(18), obs, hid)["params"]
+    q_det, _ = model.apply({"params": params}, obs, hid, True)
+    q_n1, _ = model.apply({"params": params}, obs, hid, False,
+                          rngs={"noise": jax.random.PRNGKey(1)})
+    q_n2, _ = model.apply({"params": params}, obs, hid, False,
+                          rngs={"noise": jax.random.PRNGKey(2)})
+    assert not np.allclose(q_n1, q_n2), "noise should vary with rng"
+    assert not np.allclose(q_det, q_n1)
