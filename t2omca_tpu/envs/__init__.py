@@ -1,0 +1,20 @@
+from .critic import critic
+from .mec_offload import EnvState, MultiAgvOffloadingEnv, StepInfo
+from .normalization import (NormState, RewardScaleState, normalize,
+                            reset_reward_scale, scale_reward, welford_update)
+from .registry import REGISTRY, make_env
+
+__all__ = [
+    "critic",
+    "EnvState",
+    "MultiAgvOffloadingEnv",
+    "StepInfo",
+    "NormState",
+    "RewardScaleState",
+    "normalize",
+    "welford_update",
+    "scale_reward",
+    "reset_reward_scale",
+    "REGISTRY",
+    "make_env",
+]

@@ -1,0 +1,37 @@
+"""CRITIC multi-criteria weighting (M2).
+
+The reference imports ``critic(matrix)`` for per-agent scheduling scores
+(``/root/reference/environment_multi_mec.py:3,101``); the module is not
+released. This implements the standard CRITIC method (Criteria Importance
+Through Intercriteria Correlation, Diakoulaki 1995), which SURVEY.md §2.3
+pins as the contract: weight_j ∝ std_j · Σ_k (1 − r_jk) over min-max
+normalized criteria, scores = normalized matrix · weights.
+
+NaN-robustness (the reference guards against NaN at call-site
+``environment_multi_mec.py:102-104``): degenerate columns (zero range or zero
+std) are handled with epsilons instead of producing NaN.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_EPS = 1e-12
+
+
+def critic(matrix: jnp.ndarray) -> jnp.ndarray:
+    """matrix ``(n_agents, n_criteria)`` → scores ``(n_agents,)``."""
+    x = jnp.asarray(matrix, dtype=jnp.float32)
+    lo = x.min(axis=0, keepdims=True)
+    hi = x.max(axis=0, keepdims=True)
+    xn = (x - lo) / jnp.maximum(hi - lo, _EPS)
+
+    std = xn.std(axis=0)                                  # population std
+    xc = xn - xn.mean(axis=0, keepdims=True)
+    cov = (xc.T @ xc) / xn.shape[0]
+    denom = jnp.maximum(std[:, None] * std[None, :], _EPS)
+    corr = cov / denom
+
+    info = std * (1.0 - corr).sum(axis=1)                 # C_j
+    weights = info / jnp.maximum(info.sum(), _EPS)
+    return xn @ weights

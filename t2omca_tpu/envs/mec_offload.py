@@ -1,0 +1,484 @@
+"""Multi-AGV task-offloading environment as a pure function of pytrees.
+
+TPU-native re-creation of ``MultiAgvOffloadingEnv``
+(``/root/reference/environment_multi_mec.py:9-471``, C1): every 5 ms slot each
+AGV either computes its head-of-queue job locally (action 0) or transmits it
+over one of ``num_channels`` uplink channels to its serving MEC (actions
+1..C); two AGVs picking the same channel under the same MEC collide (quirk
+Q14: channels are reusable across MECs). Reward trades offload-latency
+savings against deadline misses.
+
+Where the reference is a Python object farmed out to subprocesses over Pipes
+(``parallel_runner.py:21-32``), this is a ``reset``/``step`` pair of pure
+functions over an ``EnvState`` pytree: ``jax.vmap`` gives thousands of envs
+per chip, ``lax.scan`` gives the episode time axis, and the whole rollout
+fuses into one XLA program — there is no IPC tier to replace.
+
+Semantics preserved exactly (SURVEY.md §2.1/§7.5):
+
+* step pipeline order (``:309-366``): one-hot last_action → per-MEC bincount
+  collision resolution (counts>1 zeroed) → ACK ∈ {0 local, 1 success, −1
+  collision} → reward (uses *pre-teleport* positions) → per-agent update
+  (teleport mobility Q6, queue pop/age/expire/generate) → terminal info.
+* reward branches (``:229-293``): see ``_reward``; the ``access_reward`` is
+  computed but excluded from the returned reward (quirk Q3).
+* observations: per-agent ``[last_ack, agent_inf(5)]`` or entity mode
+  ``[ack_onehot(3), agent_inf(5), is_self]`` rows masked to same-MEC agents
+  (``:148-182``); obs pass through a per-env Welford normalizer updated on
+  every call including evaluation (Q4/Q5).
+* job queues: the reference's Python lists with mid-list deletion
+  (``:300-307``) become fixed-shape ``(max_jobs,)`` masked arrays with
+  identical within-slot ordering — pop head → age all → drop expired →
+  maybe generate (SURVEY.md §7.4(1)); ``max_jobs = latency_max/5 + 1``
+  (bound stated at ``:90``).
+
+Missing-module contracts supplied here (SURVEY.md §2.3): M1 (MEC/AGV/Job as
+arrays; parameter values pinned in docs/SPEC.md), M2 (CRITIC, ``critic.py``),
+M13 (uniform point in a circle), C2 (normalization as carried state).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..config import EnvConfig
+from .critic import critic
+from .normalization import NormState, normalize
+
+
+def _round(x: jnp.ndarray, decimals: int = 0) -> jnp.ndarray:
+    """Banker's rounding, matching python/numpy ``round`` in the reference."""
+    return jnp.round(x, decimals)
+
+
+@struct.dataclass
+class EnvState:
+    """Per-env dynamic state (one vmap lane = one reference subprocess env)."""
+
+    time_slot: jnp.ndarray        # () int32
+    mec_index: jnp.ndarray        # (A,) int32 — serving MEC per AGV
+    pos: jnp.ndarray              # (A, 2) float32 — AGV positions [m]
+    job_data: jnp.ndarray         # (A, J) float32 — data sizes [bits]
+    job_deadline: jnp.ndarray     # (A, J) float32 — remaining deadline [ms]
+    job_valid: jnp.ndarray        # (A, J) bool
+    last_ack: jnp.ndarray         # (A,) int32 ∈ {-1, 0, 1}
+    last_action: jnp.ndarray      # (A,) int32
+    task_num: jnp.ndarray         # (A,) int32 — jobs generated
+    task_success: jnp.ndarray     # (A,) int32 — jobs finished in deadline
+    remain_delay: jnp.ndarray     # (A,) float32 — completion-delay accumulator
+    norm: NormState               # obs Welford stats (shared across agents, Q4)
+
+
+@struct.dataclass
+class StepInfo:
+    """Fixed-key ``info`` dict equivalent (SURVEY.md §5.5 metric contract)."""
+
+    reward: jnp.ndarray
+    delay_reward: jnp.ndarray
+    overtime_penalty: jnp.ndarray
+    channel_utilization_rate: jnp.ndarray
+    conflict_ratio: jnp.ndarray
+    episode_limit: jnp.ndarray          # bool: terminated due to time limit
+    task_completion_rate: jnp.ndarray   # valid when episode_limit
+    task_completion_delay: jnp.ndarray  # valid when episode_limit
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiAgvOffloadingEnv:
+    """Static physics + topology; hashable, so ``jit`` can close over it.
+
+    Physics constants are the reference's (``environment_multi_mec.py:49-57``);
+    M1 parameter values (compute caps, transmit power, job distribution) are
+    the pinned spec of docs/SPEC.md.
+    """
+
+    cfg: EnvConfig
+
+    # ---- constants (reference :49-54)
+    computation_cycles: float = 31250.0   # cycles/bit
+    bandwidth: float = 5e6                # Hz
+    noise_power: float = 1e-11            # W
+    path_loss_base: float = 3.0           # NB reference uses base-3, not dB→10
+    channel_gain_db: float = 5.0
+    t_length: float = 5.0                 # ms/slot
+
+    # ---- derived sizes
+    @property
+    def n_agents(self) -> int:
+        return self.cfg.agv_num
+
+    @property
+    def n_mec(self) -> int:
+        return self.cfg.mec_num
+
+    @property
+    def n_actions(self) -> int:
+        return self.cfg.num_channels + 1
+
+    @property
+    def max_jobs(self) -> int:
+        # latency_max/5 + 1 (reference :90): a job survives ≤ latency_max/5
+        # slots after its generation slot, and ≤1 job is generated per slot.
+        return int(self.cfg.latency_max_ms / self.t_length) + 1
+
+    @property
+    def obs_entity_feats(self) -> int:
+        return 9  # ack_onehot(3) + agent_inf(5) + is_self(1)
+
+    @property
+    def state_entity_feats(self) -> int:
+        return 8  # ack_onehot(3) + agent_inf(5)
+
+    @property
+    def obs_dim(self) -> int:
+        if self.cfg.obs_entity_mode:
+            return self.n_agents * self.obs_entity_feats
+        return 6  # [last_ack, agent_inf(5)]
+
+    @property
+    def state_dim(self) -> int:
+        return self.n_agents * self.state_entity_feats
+
+    def mec_positions(self) -> jnp.ndarray:
+        """MECs on a line at spacing 2*radius (reference :23-28)."""
+        r = self.cfg.mec_radius_m
+        xs = np.arange(self.n_mec) * (2 * r) + r
+        ys = np.full(self.n_mec, r)
+        return jnp.asarray(np.stack([xs, ys], axis=1), jnp.float32)
+
+    # ------------------------------------------------------------------ helpers
+
+    def _random_positions(self, key: jax.Array,
+                          mec_index: jnp.ndarray) -> jnp.ndarray:
+        """M13: uniform point inside the serving MEC's communication circle."""
+        k1, k2 = jax.random.split(key)
+        a = self.n_agents
+        u = jax.random.uniform(k1, (a,))
+        theta = jax.random.uniform(k2, (a,), maxval=2 * np.pi)
+        rad = self.cfg.communication_range_m * jnp.sqrt(u)
+        offset = jnp.stack([rad * jnp.cos(theta), rad * jnp.sin(theta)], axis=1)
+        return self.mec_positions()[mec_index] + offset
+
+    def _local_delay(self, data: jnp.ndarray, decimals: int) -> jnp.ndarray:
+        """Local compute delay in ms (reference :127, :247-248)."""
+        return _round(self.computation_cycles * data
+                      / self.cfg.user_compute_cap * 1000.0, decimals)
+
+    def _offload_delay(self, data: jnp.ndarray, pos: jnp.ndarray,
+                       mec_index: jnp.ndarray) -> jnp.ndarray:
+        """Shannon-rate transmit delay + MEC compute delay in ms
+        (reference ``calculate_offload_delay`` :106-121). Note the quirk kept
+        verbatim: path-loss linearization uses base ``self.path_loss`` (=3),
+        i.e. ``3 ** (-dB/10)``, not ``10 ** (-dB/10)`` (:112)."""
+        gain_lin = 10.0 ** (self.channel_gain_db / 10.0)
+        d = jnp.linalg.norm(pos - self.mec_positions()[mec_index], axis=-1)
+        pl_db = 128.1 + 37.6 * jnp.log10(d + 0.1)
+        pl_lin = self.path_loss_base ** (-pl_db / 10.0)
+        snr = gain_lin * self.cfg.transmit_power_w * pl_lin / self.noise_power
+        rate = self.bandwidth * jnp.log2(1.0 + snr)
+        transmit = data / rate * 1000.0
+        compute = (self.computation_cycles * data
+                   / self.cfg.mec_compute_cap) * 1000.0
+        return _round(transmit + compute, 2)
+
+    def _agent_inf(self, state: EnvState) -> jnp.ndarray:
+        """Per-agent feature rows ``[data_size, data_delay, offload_delay,
+        remaining_delay, buffer_length]`` (reference ``get_agent_inf``
+        :123-146), zeros for empty buffers."""
+        has_job = state.job_valid[:, 0]
+        data = state.job_data[:, 0]
+        inf = jnp.stack([
+            data,
+            self._local_delay(data, 0),
+            self._offload_delay(data, state.pos, state.mec_index),
+            state.job_deadline[:, 0],
+            state.job_valid.sum(axis=1).astype(jnp.float32),
+        ], axis=1)
+        return jnp.where(has_job[:, None], inf, 0.0)
+
+    @staticmethod
+    def _ack_onehot(last_ack: jnp.ndarray) -> jnp.ndarray:
+        """ack_mapping {-1:[1,0,0], 0:[0,1,0], 1:[0,0,1]} (reference :7)."""
+        return jax.nn.one_hot(last_ack + 1, 3)
+
+    # ------------------------------------------------------------------ obs/state
+
+    def _raw_obs(self, state: EnvState) -> jnp.ndarray:
+        """(A, obs_dim) pre-normalization observations."""
+        inf = self._agent_inf(state)
+        ack1h = self._ack_onehot(state.last_ack)
+        if self.cfg.obs_entity_mode:
+            a = self.n_agents
+            rows = jnp.concatenate([ack1h, inf], axis=1)           # (A, 8)
+            same_mec = state.mec_index[:, None] == state.mec_index[None, :]
+            ent = jnp.where(same_mec[:, :, None],
+                            jnp.broadcast_to(rows[None], (a, a, 8)), 0.0)
+            is_self = jnp.eye(a)[:, :, None]       # diagonal is always same-MEC
+            ent = jnp.concatenate([ent, is_self], axis=2)          # (A, A, 9)
+            return ent.reshape(a, a * self.obs_entity_feats)
+        return jnp.concatenate(
+            [state.last_ack[:, None].astype(jnp.float32), inf], axis=1)
+
+    def get_obs(self, state: EnvState,
+                update_norm: bool = True) -> Tuple[EnvState, jnp.ndarray]:
+        """Normalized per-agent observations. The Welford state is updated
+        agent-by-agent in order, each agent normalized with the statistics
+        *after its own update* — exactly the reference's sequential
+        ``[self.obs_norm(self.get_obs_agent(i)) for i in range(n)]``
+        (``:184-186``, quirks Q4/Q5)."""
+        raw = self._raw_obs(state)
+
+        def body(carry: NormState, x):
+            carry, y = normalize(carry, x, update=update_norm)
+            return carry, y
+
+        norm, obs = jax.lax.scan(body, state.norm, raw)
+        return state.replace(norm=norm), obs
+
+    def get_state(self, state: EnvState) -> jnp.ndarray:
+        """Global state: all-agent ACK one-hots ++ all-agent agent_inf rows,
+        flattened (reference ``get_state`` :188-204); not normalized."""
+        ack1h = self._ack_onehot(state.last_ack)
+        inf = self._agent_inf(state)
+        return jnp.concatenate([ack1h.reshape(-1), inf.reshape(-1)])
+
+    def get_avail_actions(self, state: EnvState) -> jnp.ndarray:
+        """(A, n_actions) availability (reference :61-82): empty buffer ⇒ only
+        action 0; ``edge_only`` forbids local compute when a job exists."""
+        has_job = state.job_valid[:, 0]
+        idle_only = jnp.concatenate(
+            [jnp.ones((self.n_agents, 1)),
+             jnp.zeros((self.n_agents, self.n_actions - 1))], axis=1)
+        if self.cfg.edge_only:
+            busy = jnp.concatenate(
+                [jnp.zeros((self.n_agents, 1)),
+                 jnp.ones((self.n_agents, self.n_actions - 1))], axis=1)
+        else:
+            busy = jnp.ones((self.n_agents, self.n_actions))
+        return jnp.where(has_job[:, None], busy, idle_only).astype(jnp.int32)
+
+    def get_critic_score(self, state: EnvState, key: jax.Array) -> jnp.ndarray:
+        """CRITIC indicator matrix [task_prior, queueing-delay ratio,
+        buffer-fill ratio] (+1e-6-scale noise) → per-agent scores (reference
+        ``get_critic_score`` :84-104). ``task_prior`` is 1.0 for all AGVs in
+        the released slice's single-type fleet (docs/SPEC.md); queueing delay
+        is ``latency_max - remaining_deadline`` of the head job."""
+        has_job = state.job_valid[:, 0]
+        lm = self.cfg.latency_max_ms
+        prior = jnp.where(has_job, 1.0, 0.0)
+        delay_q = jnp.where(has_job,
+                            (lm - state.job_deadline[:, 0]) / lm, 0.0)
+        fill = jnp.where(
+            has_job,
+            state.job_valid.sum(axis=1) / (lm / self.t_length + 1), 0.0)
+        mat = jnp.stack([prior, delay_q, fill], axis=1)
+        noise = 1e-6 * _round(jax.random.uniform(
+            key, mat.shape, minval=0.9, maxval=1.1), 2)
+        return critic(mat + noise)
+
+    # ------------------------------------------------------------------ queues
+
+    def _generate_jobs(self, state: EnvState, key: jax.Array) -> EnvState:
+        """``AGV.generate_job`` (M1 spec): with prob ``job_prob`` append a job
+        ``(data ~ U[min,max] bits, deadline = latency_max)``; count it in
+        ``task_num``."""
+        k1, k2 = jax.random.split(key)
+        a, j = self.n_agents, self.max_jobs
+        gen = jax.random.bernoulli(k1, self.cfg.job_prob, (a,))
+        data_new = jax.random.uniform(
+            k2, (a,), minval=self.cfg.data_size_min,
+            maxval=self.cfg.data_size_max)
+        cnt = state.job_valid.sum(axis=1)
+        slot = (jnp.arange(j)[None, :] == cnt[:, None]) & gen[:, None] \
+            & (cnt[:, None] < j)
+        return state.replace(
+            job_data=jnp.where(slot, data_new[:, None], state.job_data),
+            job_deadline=jnp.where(slot, self.cfg.latency_max_ms,
+                                   state.job_deadline),
+            job_valid=state.job_valid | slot,
+            task_num=state.task_num + gen.astype(jnp.int32),
+        )
+
+    def _update_users(self, state: EnvState, ack: jnp.ndarray,
+                      key: jax.Array) -> EnvState:
+        """``update_users`` per agent (reference :295-307), vectorized:
+        teleport mobility (Q6), then pop head on ACK≠−1, age all deadlines by
+        5 ms, drop expired, maybe generate. Ordering is load-bearing
+        (SURVEY.md §7.4(1))."""
+        k_mec, k_pos, k_gen = jax.random.split(key, 3)
+
+        # Q6: i.i.d. teleport, serving MEC redrawn uniformly
+        new_mec = jax.random.randint(k_mec, (self.n_agents,), 0, self.n_mec)
+        new_pos = self._random_positions(k_pos, new_mec)
+
+        # pop head job where ACK != -1 (local compute or successful offload)
+        popped = (ack != -1) & state.job_valid[:, 0]
+        shift = lambda arr, fill: jnp.concatenate(
+            [arr[:, 1:], jnp.full_like(arr[:, :1], fill)], axis=1)
+        data = jnp.where(popped[:, None], shift(state.job_data, 0.0),
+                         state.job_data)
+        deadline = jnp.where(popped[:, None],
+                             shift(state.job_deadline, 0.0),
+                             state.job_deadline)
+        valid = jnp.where(popped[:, None], shift(state.job_valid, False),
+                          state.job_valid)
+
+        # age all remaining jobs by one slot; drop expired (deadline <= 0)
+        deadline = deadline - self.t_length
+        keep = valid & (deadline > 0)
+        # compact: stable sort invalid-last keeps FIFO order of survivors
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        data = jnp.take_along_axis(data, order, axis=1)
+        deadline = jnp.take_along_axis(deadline, order, axis=1)
+        valid = jnp.take_along_axis(keep, order, axis=1)
+
+        state = state.replace(mec_index=new_mec, pos=new_pos, job_data=data,
+                              job_deadline=deadline, job_valid=valid)
+        return self._generate_jobs(state, k_gen)
+
+    # ------------------------------------------------------------------ reward
+
+    def _reward(self, state: EnvState, ack: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, EnvState]:
+        """Reference ``get_reward`` (:229-293), vectorized over the six
+        branches. Uses pre-teleport positions and pre-update queues. Also
+        applies the task_success/remain_delay counter side-effects the
+        reference performs inside the reward pass."""
+        has_job = state.job_valid[:, 0]
+        data = state.job_data[:, 0]
+        deadline = state.job_deadline[:, 0]
+        lm = self.cfg.latency_max_ms
+
+        local_delay = self._local_delay(data, 2)              # round(x, 2)
+        offload_delay = self._offload_delay(data, state.pos, state.mec_index)
+
+        is_local = has_job & (ack == 0)
+        is_collision = has_job & (ack == -1)
+        is_offload = has_job & (ack == 1)
+
+        local_ok = is_local & (deadline - local_delay > 0)
+        local_miss = is_local & ~(deadline - local_delay > 0)
+        collision_expiring = is_collision & (deadline - self.t_length <= 0)
+        offload_ok = is_offload & (deadline - offload_delay > 0)
+        offload_miss = is_offload & ~(deadline - offload_delay > 0)
+
+        delay_reward = jnp.where(is_offload, local_delay - offload_delay,
+                                 0.0).sum()
+        overtime = (jnp.where(local_miss | collision_expiring | offload_miss,
+                              lm, 0.0)).sum()
+
+        success = local_ok | offload_ok
+        finish_delay = jnp.where(local_ok, local_delay, offload_delay)
+        new_success = state.task_success + success.astype(jnp.int32)
+        new_remain = state.remain_delay + jnp.where(
+            success, lm - deadline + finish_delay, 0.0)
+
+        reward = delay_reward - overtime                       # Q3: access_reward unused
+        state = state.replace(task_success=new_success, remain_delay=new_remain)
+        return reward, delay_reward, overtime, state
+
+    # ------------------------------------------------------------------ API
+
+    def reset(self, key: jax.Array
+              ) -> Tuple[EnvState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """→ (state, obs, global_state, avail_actions). Mirrors reference
+        ``reset``/``reset_user`` (:206-227): fresh positions, empty buffers,
+        one ``generate_job`` call, zero ACK/last_action; obs normalizer
+        persists across resets (it lives for the life of the subprocess in
+        the reference — here for the life of the EnvState unless re-created)."""
+        k_mec, k_pos, k_gen = jax.random.split(key, 3)
+        a, j = self.n_agents, self.max_jobs
+        mec_index = jax.random.randint(k_mec, (a,), 0, self.n_mec)
+        state = EnvState(
+            time_slot=jnp.zeros((), jnp.int32),
+            mec_index=mec_index,
+            pos=self._random_positions(k_pos, mec_index),
+            job_data=jnp.zeros((a, j), jnp.float32),
+            job_deadline=jnp.zeros((a, j), jnp.float32),
+            job_valid=jnp.zeros((a, j), bool),
+            last_ack=jnp.zeros((a,), jnp.int32),
+            last_action=jnp.zeros((a,), jnp.int32),
+            task_num=jnp.zeros((a,), jnp.int32),
+            task_success=jnp.zeros((a,), jnp.int32),
+            remain_delay=jnp.zeros((a,), jnp.float32),
+            norm=NormState.create(self.obs_dim),
+        )
+        state = self._generate_jobs(state, k_gen)
+        state, obs = self.get_obs(state)
+        return state, obs, self.get_state(state), self.get_avail_actions(state)
+
+    def fresh_norm(self, state: EnvState) -> EnvState:
+        return state.replace(norm=NormState.create(self.obs_dim))
+
+    def step(self, state: EnvState, actions: jnp.ndarray, key: jax.Array,
+             update_norm: bool = True
+             ) -> Tuple[EnvState, jnp.ndarray, jnp.ndarray, StepInfo,
+                        jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """→ (state', reward, terminated, info, obs', global_state', avail').
+
+        The reference worker protocol returns next-step obs/state/avail with
+        the current-step reward (``parallel_runner.py:247-256``); this fuses
+        both into one call."""
+        actions = actions.astype(jnp.int32)
+
+        # per-MEC collision resolution (reference :319-326; Q14)
+        counts = jnp.zeros((self.n_mec, self.n_actions), jnp.int32)
+        counts = counts.at[state.mec_index, actions].add(1)
+        masked = jnp.where(counts > 1, 0, counts)
+        # utilization sums ALL slots incl. action-0 (reference :327-329 quirk)
+        utilization = masked.sum() / (self.cfg.num_channels * self.n_mec)
+
+        chosen = masked[state.mec_index, actions]
+        ack = jnp.where(actions == 0, 0, jnp.where(chosen == 1, 1, -1))
+        conflict_ratio = (ack == -1).mean()
+
+        state = state.replace(
+            time_slot=state.time_slot + 1,
+            last_action=actions,
+            last_ack=ack,
+        )
+
+        reward, delay_reward, overtime, state = self._reward(state, ack)
+        state = self._update_users(state, ack, key)
+
+        terminated = state.time_slot >= self.cfg.episode_limit
+        tn = state.task_num.sum()
+        ts = state.task_success.sum()
+        info = StepInfo(
+            reward=reward,
+            delay_reward=delay_reward,
+            overtime_penalty=overtime,
+            channel_utilization_rate=utilization,
+            conflict_ratio=conflict_ratio,
+            episode_limit=terminated,
+            task_completion_rate=ts / jnp.maximum(tn, 1),
+            task_completion_delay=state.remain_delay.sum()
+            / jnp.maximum(ts, 1),
+        )
+
+        state, obs = self.get_obs(state, update_norm=update_norm)
+        return (state, reward, terminated, info, obs,
+                self.get_state(state), self.get_avail_actions(state))
+
+    def get_env_info(self) -> Dict[str, int]:
+        """Reference ``get_env_info`` (:421-439); copied onto args by the
+        driver (``per_run.py:112-114``)."""
+        info = {
+            "state_shape": self.state_dim,
+            "obs_shape": self.obs_dim,
+            "n_actions": self.n_actions,
+            "n_agents": self.n_agents,
+            "episode_limit": self.cfg.episode_limit,
+            "n_entities": self.n_agents,
+        }
+        if self.cfg.obs_entity_mode:
+            info["obs_entity_feats"] = self.obs_entity_feats
+        if self.cfg.state_entity_mode:
+            info["state_entity_feats"] = self.state_entity_feats
+        return info

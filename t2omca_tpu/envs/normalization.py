@@ -1,0 +1,97 @@
+"""Running normalization as pure functions on carried state (C2).
+
+Re-creates ``/root/reference/normalization.py`` with its two quirks
+(SURVEY.md §7.5):
+
+* **Q5** — the Welford update's *first* sample sets ``std = x`` (not 0)
+  (``normalization.py:16-18``), so the first normalized output is exactly 0
+  via ``(x - x)/(x + 1e-8)``.
+* **Q4** — the observation normalizer is updated on every call, including
+  evaluation (``environment_multi_mec.py:184-186``); callers here decide by
+  passing ``update``.
+
+The reference keeps one mutable ``Normalization`` object per env subprocess;
+here the statistics are a ``NormState`` pytree carried inside ``EnvState`` so
+each vmapped env keeps independent statistics (SURVEY.md §7.4(3)).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class NormState:
+    """Welford running statistics (reference ``RunningMeanStd``)."""
+
+    n: jnp.ndarray       # scalar int32 sample count
+    mean: jnp.ndarray    # (dim,)
+    s: jnp.ndarray       # (dim,) sum of squared deviations
+    std: jnp.ndarray     # (dim,)
+
+    @classmethod
+    def create(cls, dim: int) -> "NormState":
+        z = jnp.zeros((dim,), jnp.float32)
+        return cls(n=jnp.zeros((), jnp.int32), mean=z, s=z, std=z)
+
+
+def welford_update(state: NormState, x: jnp.ndarray) -> NormState:
+    """One ``RunningMeanStd.update`` step (``normalization.py:12-22``)."""
+    n1 = state.n + 1
+    first = n1 == 1
+    new_mean = jnp.where(first, x, state.mean + (x - state.mean) / n1)
+    new_s = jnp.where(first, state.s,
+                      state.s + (x - state.mean) * (x - new_mean))
+    new_std = jnp.where(first, x, jnp.sqrt(new_s / n1))   # Q5: first std = x
+    return NormState(n=n1, mean=new_mean, s=new_s, std=new_std)
+
+
+def normalize(state: NormState, x: jnp.ndarray,
+              update=True) -> Tuple[NormState, jnp.ndarray]:
+    """``Normalization.__call__`` (``normalization.py:29-35``): optionally
+    update, then normalize with the (post-update) statistics. ``update`` may
+    be a Python bool or a traced scalar bool (so evaluation rollouts can flip
+    it inside one jitted program)."""
+    if isinstance(update, bool):
+        if update:
+            state = welford_update(state, x)
+    else:
+        updated = welford_update(state, x)
+        u = jnp.asarray(update)
+        state = jax.tree.map(lambda a, b: jnp.where(u, a, b), updated, state)
+    y = (x - state.mean) / (state.std + 1e-8)
+    return state, y
+
+
+@struct.dataclass
+class RewardScaleState:
+    """``RewardScaling`` carried state (``normalization.py:38-52``): a
+    discounted return whose running std divides rewards. Imported by the
+    reference env but never instantiated in the released slice — provided for
+    capability parity."""
+
+    norm: NormState
+    r: jnp.ndarray       # discounted return accumulator
+    gamma: float = struct.field(pytree_node=False, default=0.99)
+
+    @classmethod
+    def create(cls, gamma: float, dim: int = 1) -> "RewardScaleState":
+        return cls(norm=NormState.create(dim),
+                   r=jnp.zeros((dim,), jnp.float32), gamma=gamma)
+
+
+def scale_reward(state: RewardScaleState,
+                 x: jnp.ndarray) -> Tuple[RewardScaleState, jnp.ndarray]:
+    r = state.gamma * state.r + x
+    norm = welford_update(state.norm, r)
+    y = x / (norm.std + 1e-8)
+    return RewardScaleState(norm=norm, r=r, gamma=state.gamma), y
+
+
+def reset_reward_scale(state: RewardScaleState) -> RewardScaleState:
+    return RewardScaleState(norm=state.norm, r=jnp.zeros_like(state.r),
+                            gamma=state.gamma)

@@ -1,0 +1,27 @@
+"""Environment registry (M3).
+
+The reference runner builds envs through ``envs.REGISTRY[name](**env_args)``
+(``/root/reference/parallel_runner.py:1,22``); here the registry maps names to
+functional-env constructors taking an ``EnvConfig``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from ..config import EnvConfig
+from .mec_offload import MultiAgvOffloadingEnv
+
+REGISTRY: Dict[str, Callable[[EnvConfig], MultiAgvOffloadingEnv]] = {
+    "multi_agv_offloading": MultiAgvOffloadingEnv,
+    "multi_mec": MultiAgvOffloadingEnv,   # reference map_name alias
+}
+
+
+def make_env(cfg: EnvConfig) -> MultiAgvOffloadingEnv:
+    try:
+        ctor = REGISTRY[cfg.key]
+    except KeyError:
+        raise KeyError(
+            f"unknown env '{cfg.key}'; registered: {sorted(REGISTRY)}")
+    return ctor(cfg)

@@ -13,8 +13,6 @@ tensors whose keys mirror the flax param tree.
 
 from __future__ import annotations
 
-import math
-
 import torch
 import torch.nn.functional as F
 

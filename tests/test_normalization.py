@@ -1,0 +1,77 @@
+"""Welford normalizer + reward scaling: quirk-level parity with the reference
+``normalization.py`` (C2), verified against a direct NumPy transcription."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from t2omca_tpu.envs.normalization import (NormState, RewardScaleState,
+                                           normalize, reset_reward_scale,
+                                           scale_reward, welford_update)
+
+
+class NumpyOracle:
+    """Independent transcription of reference RunningMeanStd semantics."""
+
+    def __init__(self, dim):
+        self.n, self.mean, self.S = 0, np.zeros(dim), np.zeros(dim)
+        self.std = np.zeros(dim)
+
+    def update(self, x):
+        x = np.asarray(x, float)
+        self.n += 1
+        if self.n == 1:
+            self.mean, self.std = x.copy(), x.copy()   # Q5
+        else:
+            old = self.mean.copy()
+            self.mean = old + (x - old) / self.n
+            self.S = self.S + (x - old) * (x - self.mean)
+            self.std = np.sqrt(self.S / self.n)
+
+    def norm(self, x, update=True):
+        if update:
+            self.update(x)
+        return (x - self.mean) / (self.std + 1e-8)
+
+
+def test_welford_matches_oracle_sequence():
+    rng = np.random.default_rng(0)
+    xs = rng.normal(3.0, 2.0, size=(50, 4)).astype(np.float32)
+    oracle = NumpyOracle(4)
+    st = NormState.create(4)
+    for x in xs:
+        st, y = normalize(st, jnp.asarray(x))
+        y_ref = oracle.norm(x)
+        np.testing.assert_allclose(np.asarray(y), y_ref, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(st.mean), oracle.mean, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(st.std), oracle.std, rtol=1e-4)
+
+
+def test_first_sample_quirk_q5():
+    st = NormState.create(3)
+    x = jnp.asarray([2.0, -1.0, 5.0])
+    st, y = normalize(st, x)
+    # first sample: std = x, mean = x -> normalized output exactly 0
+    np.testing.assert_allclose(np.asarray(st.std), np.asarray(x))
+    np.testing.assert_allclose(np.asarray(y), 0.0, atol=1e-6)
+
+
+def test_no_update_path_q4():
+    st = NormState.create(2)
+    st, _ = normalize(st, jnp.asarray([1.0, 2.0]))
+    st2, _ = normalize(st, jnp.asarray([5.0, 5.0]), update=False)
+    assert int(st2.n) == int(st.n)
+    np.testing.assert_allclose(np.asarray(st2.mean), np.asarray(st.mean))
+
+
+def test_reward_scaling_matches_oracle():
+    rng = np.random.default_rng(1)
+    rs = RewardScaleState.create(gamma=0.9, dim=1)
+    R, o = np.zeros(1), NumpyOracle(1)
+    for r in rng.normal(size=20).astype(np.float32):
+        rs, y = scale_reward(rs, jnp.asarray([r]))
+        R = 0.9 * R + r
+        o.update(R)
+        np.testing.assert_allclose(np.asarray(y), r / (o.std + 1e-8),
+                                   rtol=1e-4, atol=1e-5)
+    rs = reset_reward_scale(rs)
+    np.testing.assert_allclose(np.asarray(rs.r), 0.0)
