@@ -1,0 +1,18 @@
+from .action_selectors import (EpsilonGreedySelector, NoisySelector,
+                               SELECTOR_REGISTRY)
+from .episode_buffer import (BufferState, EpisodeBatch, ReplayBuffer,
+                             PrioritizedReplayBuffer)
+from .schedules import DecayThenFlatSchedule
+from .transforms import one_hot
+
+__all__ = [
+    "DecayThenFlatSchedule",
+    "EpsilonGreedySelector",
+    "NoisySelector",
+    "SELECTOR_REGISTRY",
+    "EpisodeBatch",
+    "BufferState",
+    "ReplayBuffer",
+    "PrioritizedReplayBuffer",
+    "one_hot",
+]

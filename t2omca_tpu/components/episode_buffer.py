@@ -1,0 +1,203 @@
+"""Episode batch + replay buffers as device-resident pytrees (M4).
+
+Re-creates the contracts of the unreleased ``components/episode_buffer``
+(``EpisodeBatch`` / ``ReplayBuffer`` / ``PrioritizedReplayBuffer``, imported
+at ``/root/reference/parallel_runner.py:3`` and ``/root/reference/per_run.py:16``;
+contracts pinned in SURVEY.md §2.3 M4) — but where the reference keeps a
+torch-tensor dict on CPU/GPU and slices it with Python, here the whole buffer
+is a fixed-shape pytree living in device HBM and every operation (insert,
+sample, priority update) is a pure jittable function. Sampling never leaves
+the chip, so the rollout→insert→sample→train loop compiles into a handful of
+XLA programs with no host round-trips.
+
+Scheme (reference ``per_run.py:119-133``): ``state (T+1, S)``, per-agent
+``obs (T+1, A, O)``, ``avail_actions (T+1, A, n_actions)``, ``actions (T, A)``,
+``reward (T,)``, ``terminated (T,)``, ``filled (T,)``. The trailing
+timestep T of obs/state/avail is the bootstrap observation (the reference
+stores ``episode_limit + 1`` steps per episode, ``per_run.py:143-146``).
+``actions_onehot`` (M15) is materialized on demand by the consumer, not
+stored.
+
+Prioritized replay: per-*episode* priorities (the reference samples whole
+episodes and feeds back one ``|TD|+1e-6`` priority per sampled episode,
+``per_run.py:224-238``, Q9). Instead of a sequential sum-tree — hostile to
+XLA — sampling uses stratified inverse-CDF over the normalized priority
+distribution (SURVEY.md §7.4(4)): O(capacity) vectorized ops, exact for the
+β-weighted expectation, fine at the reference's buffer sizes (≤ a few
+thousand episodes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class EpisodeBatch:
+    """One (batch of) episode(s): arrays shaped ``(B, T(+1), ...)``."""
+
+    obs: jnp.ndarray            # (B, T+1, A, obs_dim) float32
+    state: jnp.ndarray          # (B, T+1, state_dim) float32
+    avail_actions: jnp.ndarray  # (B, T+1, A, n_actions) int32
+    actions: jnp.ndarray        # (B, T, A) int32
+    reward: jnp.ndarray         # (B, T) float32
+    terminated: jnp.ndarray     # (B, T) bool — env-terminal, time-limit excluded (Q7)
+    filled: jnp.ndarray         # (B, T) bool
+
+    @property
+    def batch_size(self) -> int:
+        return self.obs.shape[0]
+
+    @property
+    def max_seq_length(self) -> int:
+        return self.actions.shape[1]
+
+    def max_t_filled(self) -> jnp.ndarray:
+        """Longest filled prefix across the batch (reference
+        ``per_run.py:226-227`` truncates the sampled batch to it; with static
+        shapes we keep full length and rely on the masks instead)."""
+        return self.filled.sum(axis=1).max()
+
+
+@struct.dataclass
+class BufferState:
+    """Ring buffer over episodes + PER priorities, all device-resident."""
+
+    storage: EpisodeBatch       # arrays (capacity, T(+1), ...)
+    insert_pos: jnp.ndarray     # () int32 — next ring slot
+    episodes_in_buffer: jnp.ndarray  # () int32
+    priorities: jnp.ndarray     # (capacity,) float32 — p^alpha NOT pre-applied
+    max_priority: jnp.ndarray   # () float32 — running max, for new inserts
+
+
+def _zeros_like_episode(n_agents: int, n_actions: int, obs_dim: int,
+                        state_dim: int, t: int, batch: int) -> EpisodeBatch:
+    return EpisodeBatch(
+        obs=jnp.zeros((batch, t + 1, n_agents, obs_dim), jnp.float32),
+        state=jnp.zeros((batch, t + 1, state_dim), jnp.float32),
+        avail_actions=jnp.zeros((batch, t + 1, n_agents, n_actions), jnp.int32),
+        actions=jnp.zeros((batch, t, n_agents), jnp.int32),
+        reward=jnp.zeros((batch, t), jnp.float32),
+        terminated=jnp.zeros((batch, t), bool),
+        filled=jnp.zeros((batch, t), bool),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplayBuffer:
+    """Uniform episode replay (the reference's commented-out default,
+    ``per_run.py:135-141``). All methods are pure: ``state' = f(state, ...)``."""
+
+    capacity: int               # episodes (reference buffer_size)
+    episode_limit: int
+    n_agents: int
+    n_actions: int
+    obs_dim: int
+    state_dim: int
+
+    def init(self) -> BufferState:
+        return BufferState(
+            storage=_zeros_like_episode(
+                self.n_agents, self.n_actions, self.obs_dim, self.state_dim,
+                self.episode_limit, self.capacity),
+            insert_pos=jnp.zeros((), jnp.int32),
+            episodes_in_buffer=jnp.zeros((), jnp.int32),
+            priorities=jnp.zeros((self.capacity,), jnp.float32),
+            max_priority=jnp.ones((), jnp.float32),
+        )
+
+    def insert_episode_batch(self, state: BufferState,
+                             batch: EpisodeBatch) -> BufferState:
+        """Ring-insert ``B`` episodes; overwrites oldest when full (the
+        reference's EpisodeBatch ring semantics). New episodes get the running
+        max priority (standard PER; reference feeds real |TD| back after the
+        first sample, Q9)."""
+        b = batch.batch_size
+        idx = (state.insert_pos + jnp.arange(b)) % self.capacity
+        storage = jax.tree.map(
+            lambda s, x: s.at[idx].set(x), state.storage, batch)
+        return state.replace(
+            storage=storage,
+            insert_pos=(state.insert_pos + b) % self.capacity,
+            episodes_in_buffer=jnp.minimum(
+                state.episodes_in_buffer + b, self.capacity),
+            priorities=state.priorities.at[idx].set(state.max_priority),
+        )
+
+    def can_sample(self, state: BufferState, batch_size: int) -> jnp.ndarray:
+        return state.episodes_in_buffer >= batch_size
+
+    def _gather(self, state: BufferState, idx: jnp.ndarray) -> EpisodeBatch:
+        return jax.tree.map(lambda s: s[idx], state.storage)
+
+    def sample(self, state: BufferState, key: jax.Array, batch_size: int,
+               t_env: jnp.ndarray = 0
+               ) -> Tuple[EpisodeBatch, jnp.ndarray, jnp.ndarray]:
+        """→ (batch, idx, weights). Uniform without replacement (weights = 1),
+        same return signature as PER so the driver is agnostic
+        (``per_run.py:224``)."""
+        del t_env
+        n = state.episodes_in_buffer
+        # top-batch_size of random scores over valid slots ≡ sampling without
+        # replacement with static shapes (caller gates on can_sample)
+        scores = jax.random.uniform(key, (self.capacity,))
+        scores = jnp.where(jnp.arange(self.capacity) < n, scores, -jnp.inf)
+        _, idx = jax.lax.top_k(scores, batch_size)
+        return self._gather(state, idx), idx, jnp.ones((batch_size,))
+
+    def update_priorities(self, state: BufferState, idx: jnp.ndarray,
+                          priorities: jnp.ndarray) -> BufferState:
+        del idx, priorities
+        return state  # uniform: no-op
+
+
+@dataclasses.dataclass(frozen=True)
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional PER over episodes (reference ``per_run.py:143-146``):
+    ``P(i) ∝ p_i^alpha``, importance weights ``(N·P(i))^-β`` normalized by
+    their max, β annealed linearly from ``per_beta`` to 1 over ``t_max`` env
+    steps (the ctor's ``t_max`` argument)."""
+
+    alpha: float = 0.6
+    beta0: float = 0.4
+    t_max: int = 1
+
+    def _probs(self, state: BufferState) -> jnp.ndarray:
+        valid = jnp.arange(self.capacity) < state.episodes_in_buffer
+        p = jnp.where(valid, state.priorities, 0.0) ** self.alpha
+        p = jnp.where(valid, p, 0.0)
+        return p / jnp.maximum(p.sum(), 1e-12)
+
+    def sample(self, state: BufferState, key: jax.Array, batch_size: int,
+               t_env: jnp.ndarray = 0
+               ) -> Tuple[EpisodeBatch, jnp.ndarray, jnp.ndarray]:
+        probs = self._probs(state)
+        cdf = jnp.cumsum(probs)
+        # stratified inverse-CDF: one uniform per equal-mass stratum
+        u = (jnp.arange(batch_size)
+             + jax.random.uniform(key, (batch_size,))) / batch_size
+        idx = jnp.searchsorted(cdf, u * cdf[-1], side="left")
+        idx = jnp.clip(idx, 0, self.capacity - 1)
+
+        beta = self.beta0 + (1.0 - self.beta0) * jnp.clip(
+            jnp.asarray(t_env, jnp.float32) / self.t_max, 0.0, 1.0)
+        n = jnp.maximum(state.episodes_in_buffer, 1).astype(jnp.float32)
+        w = (n * jnp.maximum(probs[idx], 1e-12)) ** (-beta)
+        w = w / jnp.maximum(w.max(), 1e-12)
+        return self._gather(state, idx), idx, w
+
+    def update_priorities(self, state: BufferState, idx: jnp.ndarray,
+                          priorities: jnp.ndarray) -> BufferState:
+        """Feed |TD|+1e-6 back for the sampled episodes (Q9). Duplicate
+        indices resolve to one of the written values (XLA scatter), matching
+        the reference's last-write-wins dict update."""
+        pri = state.priorities.at[idx].set(priorities)
+        return state.replace(
+            priorities=pri,
+            max_priority=jnp.maximum(state.max_priority, priorities.max()),
+        )

@@ -1,0 +1,29 @@
+"""Exploration schedules.
+
+The reference's action selector exposes an annealed ``.epsilon`` read by the
+runner for logging (``/root/reference/parallel_runner.py:217-218``); the
+schedule itself is part of the unreleased controllers package (M7). PyMARL's
+``DecayThenFlatSchedule`` (linear decay to a floor) is the lineage standard
+and is what we pin here — expressed as a pure function of ``t_env`` so it
+works under ``jit``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class DecayThenFlatSchedule:
+    """Linear decay from ``start`` to ``finish`` over ``time_length`` env
+    steps, flat afterwards."""
+
+    start: float
+    finish: float
+    time_length: int
+
+    def eval(self, t: jnp.ndarray) -> jnp.ndarray:
+        frac = jnp.clip(t / self.time_length, 0.0, 1.0)
+        return self.start + frac * (self.finish - self.start)

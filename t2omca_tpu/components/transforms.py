@@ -1,0 +1,18 @@
+"""Batch preprocess transforms (M15).
+
+The reference driver registers ``actions → actions_onehot`` as an on-insert
+preprocess (``/root/reference/per_run.py:17,133``, ``components/transforms``
+→ ``OneHot``). Here transforms are plain functions applied where the consumer
+needs them (the learner one-hots actions on the fly — cheaper than storing
+the expansion in replay HBM).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def one_hot(actions: jnp.ndarray, n_actions: int) -> jnp.ndarray:
+    """``OneHot(out_dim=n_actions)``: int action indices → one-hot float rows."""
+    return jax.nn.one_hot(actions, n_actions, dtype=jnp.float32)

@@ -95,6 +95,7 @@ class TrainConfig:
     accumulated_episodes: int = 0         # min episodes collected before training
     use_cuda: bool = False                # parity flag; device selection is JAX's
     evaluate: bool = False
+    benchmark_mode: bool = False          # export per-episode CSV during eval
     checkpoint_path: str = ""
     load_step: int = 0
     save_model: bool = True

@@ -1,0 +1,3 @@
+from .basic_mac import BasicMAC, MAC_REGISTRY
+
+__all__ = ["BasicMAC", "MAC_REGISTRY"]

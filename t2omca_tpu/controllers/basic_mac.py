@@ -1,0 +1,107 @@
+"""Multi-agent controller (M7, the unreleased ``controllers`` package).
+
+Contract pinned by the call sites (SURVEY.md §2.3 M7): owns the shared-
+parameter agent network and the action selector; ``init_hidden(batch)``;
+``select_actions(batch_slice, t_env, key, test_mode)`` masking illegal
+actions with ``avail_actions``; agents grouped under ``"agents"`` share one
+parameter set (the reference folds the agent axis into the batch axis,
+``/root/reference/transf_agent.py:56-59`` — we do the same inside
+``TransformerAgent``).
+
+Functional form: the MAC is a frozen descriptor (module + selector); all
+state (params, hidden tokens) is passed explicitly, so the same MAC drives
+the jitted rollout scan, the learner's time unroll, and greedy evaluation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..components.action_selectors import SELECTOR_REGISTRY
+from ..components.schedules import DecayThenFlatSchedule
+from ..config import TrainConfig
+from ..models.agent import TransformerAgent
+
+
+@dataclasses.dataclass(frozen=True)
+class BasicMAC:
+    agent: TransformerAgent
+    selector: object            # EpsilonGreedySelector | NoisySelector
+    n_agents: int
+    n_actions: int
+    emb: int
+
+    @classmethod
+    def build(cls, cfg: TrainConfig, env_info: dict) -> "BasicMAC":
+        n_agents = env_info["n_agents"]
+        n_entities = cfg.model.n_entities_obs or env_info["n_entities"]
+        feat = env_info.get("obs_entity_feats")
+        if feat is None:
+            # flat-obs mode: the whole obs vector is one entity token
+            n_entities, feat = 1, env_info["obs_shape"]
+        agent = TransformerAgent(
+            n_agents=n_agents,
+            n_entities=n_entities + 0,
+            feat_dim=feat,
+            emb=cfg.model.emb,
+            heads=cfg.model.heads,
+            depth=cfg.model.depth,
+            n_actions=env_info["n_actions"],
+            ff_hidden_mult=cfg.model.ff_hidden_mult,
+            dropout=cfg.model.dropout,
+            noisy=cfg.action_selector == "noisy-new",
+            standard_heads=cfg.model.standard_heads,
+            use_orthogonal=cfg.model.use_orthogonal,
+        )
+        schedule = DecayThenFlatSchedule(
+            cfg.epsilon_start, cfg.epsilon_finish, cfg.epsilon_anneal_time)
+        selector = SELECTOR_REGISTRY[cfg.action_selector](schedule)
+        return cls(agent=agent, selector=selector, n_agents=n_agents,
+                   n_actions=env_info["n_actions"], emb=cfg.model.emb)
+
+    # ------------------------------------------------------------------ state
+
+    def init_params(self, key: jax.Array, obs_dim: int):
+        obs = jnp.zeros((1, self.n_agents, obs_dim))
+        h = self.init_hidden(1)
+        return self.agent.init(key, obs, h)
+
+    def init_hidden(self, batch_size: int) -> jnp.ndarray:
+        """Zeros ``(batch, n_agents, emb)`` (``transf_agent.py:50-52``)."""
+        return self.agent.initial_hidden(batch_size)
+
+    # ------------------------------------------------------------------ forward
+
+    def forward(self, params, obs: jnp.ndarray, hidden: jnp.ndarray,
+                key: jax.Array | None = None, deterministic: bool = True
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """obs ``(B, A, obs_dim)`` → (q ``(B, A, n_actions)``, hidden').
+        ``key`` seeds NoisyLinear resampling and dropout when
+        ``deterministic`` is False."""
+        if key is not None:
+            k_noise, k_drop = jax.random.split(key)
+            rngs = {"noise": k_noise, "dropout": k_drop}
+        else:
+            rngs = None
+        return self.agent.apply(params, obs, hidden,
+                                deterministic=deterministic, rngs=rngs)
+
+    def select_actions(self, params, obs: jnp.ndarray, avail: jnp.ndarray,
+                       hidden: jnp.ndarray, key: jax.Array,
+                       t_env: jnp.ndarray, test_mode: bool = False
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """→ (actions ``(B, A)`` int32, hidden', epsilon). The avail mask is
+        applied inside the selector (illegal-action masking, M7)."""
+        k_noise, k_sel = jax.random.split(key)
+        q, hidden = self.forward(params, obs, hidden, key=k_noise,
+                                 deterministic=test_mode)
+        actions, eps = self.selector.select(k_sel, q, avail, t_env,
+                                            test_mode=test_mode)
+        return actions.astype(jnp.int32), hidden, eps
+
+
+MAC_REGISTRY = {"basic_mac": BasicMAC}

@@ -385,13 +385,13 @@ class MultiAgvOffloadingEnv:
 
     # ------------------------------------------------------------------ API
 
-    def reset(self, key: jax.Array
+    def reset(self, key: jax.Array, norm: NormState | None = None
               ) -> Tuple[EnvState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """→ (state, obs, global_state, avail_actions). Mirrors reference
         ``reset``/``reset_user`` (:206-227): fresh positions, empty buffers,
         one ``generate_job`` call, zero ACK/last_action; obs normalizer
         persists across resets (it lives for the life of the subprocess in
-        the reference — here for the life of the EnvState unless re-created)."""
+        the reference — pass the previous episode's ``norm`` to carry it)."""
         k_mec, k_pos, k_gen = jax.random.split(key, 3)
         a, j = self.n_agents, self.max_jobs
         mec_index = jax.random.randint(k_mec, (a,), 0, self.n_mec)
@@ -407,7 +407,7 @@ class MultiAgvOffloadingEnv:
             task_num=jnp.zeros((a,), jnp.int32),
             task_success=jnp.zeros((a,), jnp.int32),
             remain_delay=jnp.zeros((a,), jnp.float32),
-            norm=NormState.create(self.obs_dim),
+            norm=NormState.create(self.obs_dim) if norm is None else norm,
         )
         state = self._generate_jobs(state, k_gen)
         state, obs = self.get_obs(state)

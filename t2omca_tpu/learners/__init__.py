@@ -1,0 +1,3 @@
+from .qmix_learner import LearnerState, QMixLearner, LEARNER_REGISTRY
+
+__all__ = ["QMixLearner", "LearnerState", "LEARNER_REGISTRY"]

@@ -1,0 +1,233 @@
+"""QMIX TD learner (M8, the unreleased ``learners`` package).
+
+Contract pinned by the call sites (SURVEY.md §2.3 M8, §3.3): per-agent Qs
+from the TransformerAgent, chosen-action Qs mixed by the TransformerMixer
+into ``q_tot``, target-network + double-Q targets, importance-weighted MSE on
+the TD error, and ``info["td_errors_abs"]`` flowing back as PER priorities
+(``/root/reference/per_run.py:233-238``, Q9).
+
+TPU shape: the reference's sequential Python ``for t in range(T)`` becomes a
+``lax.scan`` over the time axis carrying BOTH recurrent streams — the agent
+hidden token (``transf_agent.py:71``) and the mixer's 3 hyper tokens
+(``n_transf_mixer.py:91``) — for the online and target networks. The whole
+train step (two unrolls, loss, grads, optimizer update, conditional hard
+target sync) is one pure function → one XLA program; batch and agent axes
+ride the MXU, the only sequential dimension is episode time.
+
+Masking: sampled episodes keep static length ``T`` (no ``max_t_filled``
+truncation — XLA wants static shapes); the ``filled`` mask plays the role of
+the reference's truncation (``per_run.py:226-227``), and time-limit episodes
+bootstrap because ``terminated`` excludes the time-limit step (Q7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from ..components.episode_buffer import EpisodeBatch
+from ..config import TrainConfig
+from ..controllers.basic_mac import BasicMAC
+from ..models.mixer import TransformerMixer
+
+
+@struct.dataclass
+class LearnerState:
+    params: Any                   # {"agent": ..., "mixer": ...}
+    target_params: Any
+    opt_state: Any
+    train_steps: jnp.ndarray      # () int32
+    last_target_update: jnp.ndarray  # () int32 — episode of last hard sync
+
+
+def _make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    if cfg.optimizer == "rmsprop":
+        opt = optax.rmsprop(cfg.lr, decay=cfg.optim_alpha, eps=cfg.optim_eps)
+    else:
+        opt = optax.adam(cfg.lr, eps=cfg.optim_eps)
+    return optax.chain(optax.clip_by_global_norm(cfg.grad_norm_clip), opt)
+
+
+@dataclasses.dataclass(frozen=True)
+class QMixLearner:
+    mac: BasicMAC
+    mixer: TransformerMixer
+    cfg: TrainConfig
+    obs_dim: int
+    state_dim: int
+
+    @classmethod
+    def build(cls, cfg: TrainConfig, mac: BasicMAC,
+              env_info: dict) -> "QMixLearner":
+        n_entities = cfg.model.n_entities_state or env_info["n_entities"]
+        state_entity_mode = "state_entity_feats" in env_info
+        if state_entity_mode:
+            feat = env_info["state_entity_feats"]
+        else:
+            # Q12 fallback: mixer tokenizes all agents' obs entities
+            feat = env_info["obs_entity_feats"]
+            n_entities = env_info["n_entities"]
+        mixer = TransformerMixer(
+            n_agents=env_info["n_agents"],
+            n_entities=n_entities,
+            feat_dim=feat,
+            emb=cfg.model.mixer_emb,
+            heads=cfg.model.mixer_heads,
+            depth=cfg.model.mixer_depth,
+            ff_hidden_mult=cfg.model.ff_hidden_mult,
+            dropout=cfg.model.dropout,
+            qmix_pos_func=cfg.model.qmix_pos_func,
+            qmix_pos_func_beta=cfg.model.qmix_pos_func_beta,
+            state_entity_mode=state_entity_mode,
+            standard_heads=cfg.model.standard_heads,
+            use_orthogonal=cfg.model.use_orthogonal,
+        )
+        return cls(mac=mac, mixer=mixer, cfg=cfg,
+                   obs_dim=env_info["obs_shape"],
+                   state_dim=env_info["state_shape"])
+
+    # ------------------------------------------------------------------ state
+
+    def init_state(self, key: jax.Array) -> LearnerState:
+        k_agent, k_mixer = jax.random.split(key)
+        agent_params = self.mac.init_params(k_agent, self.obs_dim)
+        b, a, e = 1, self.mac.n_agents, self.cfg.model.mixer_emb
+        mixer_params = self.mixer.init(
+            k_mixer,
+            jnp.zeros((b, 1, a)),                      # qvals
+            jnp.zeros((b, a, self.cfg.model.emb)),     # agent hiddens
+            self.mixer.initial_hyper(b),               # 3 hyper tokens
+            jnp.zeros((b, self.state_dim)),            # state
+            jnp.zeros((b, a, self.obs_dim)),           # obs (Q12 path)
+        )
+        params = {"agent": agent_params, "mixer": mixer_params}
+        return LearnerState(
+            params=params,
+            target_params=jax.tree.map(jnp.copy, params),
+            opt_state=_make_optimizer(self.cfg).init(params),
+            train_steps=jnp.zeros((), jnp.int32),
+            last_target_update=jnp.zeros((), jnp.int32),
+        )
+
+    # ------------------------------------------------------------------ unrolls
+
+    def _unroll_agent(self, agent_params, obs_tm: jnp.ndarray
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """obs_tm ``(T1, B, A, O)`` → (q ``(T1, B, A, n_actions)``,
+        hiddens ``(T1, B, A, emb)``); carries the recurrent hidden token."""
+        b = obs_tm.shape[1]
+
+        def body(h, obs_t):
+            q, h = self.mac.forward(agent_params, obs_t, h)
+            return h, (q, h)
+
+        _, (qs, hs) = jax.lax.scan(body, self.mac.init_hidden(b), obs_tm)
+        return qs, hs
+
+    def _unroll_mixer(self, mixer_params, q_tm: jnp.ndarray,
+                      hid_tm: jnp.ndarray, state_tm: jnp.ndarray,
+                      obs_tm: jnp.ndarray) -> jnp.ndarray:
+        """q_tm ``(T, B, A)`` → ``q_tot (T, B)``; carries the 3 hyper tokens
+        across time (``n_transf_mixer.py:91``)."""
+        b = q_tm.shape[1]
+
+        def body(hyper, xs):
+            qv, h, s, o = xs
+            q_tot, hyper = self.mixer.apply(
+                mixer_params, qv[:, None, :], h, hyper, s, o)
+            return hyper, q_tot[:, 0, 0]
+
+        _, q_tots = jax.lax.scan(
+            body, self.mixer.initial_hyper(b), (q_tm, hid_tm, state_tm, obs_tm))
+        return q_tots
+
+    # ------------------------------------------------------------------ loss
+
+    def _loss(self, params, target_params, batch: EpisodeBatch,
+              weights: jnp.ndarray) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        cfg = self.cfg
+        # time-major views
+        obs = jnp.swapaxes(batch.obs, 0, 1)               # (T+1, B, A, O)
+        state = jnp.swapaxes(batch.state, 0, 1)           # (T+1, B, S)
+        avail = jnp.swapaxes(batch.avail_actions, 0, 1)   # (T+1, B, A, n)
+        actions = jnp.swapaxes(batch.actions, 0, 1)       # (T, B, A)
+        reward = jnp.swapaxes(batch.reward, 0, 1)         # (T, B)
+        term = jnp.swapaxes(batch.terminated, 0, 1).astype(jnp.float32)
+        mask = jnp.swapaxes(batch.filled, 0, 1).astype(jnp.float32)
+
+        qs, hs = self._unroll_agent(params["agent"], obs)
+        target_qs, target_hs = self._unroll_agent(target_params["agent"], obs)
+
+        chosen = jnp.take_along_axis(
+            qs[:-1], actions[..., None], axis=-1)[..., 0]  # (T, B, A)
+
+        # illegal actions suppressed in targets (MAC masking contract)
+        masked_next = jnp.where(avail[1:] > 0, qs[1:], -jnp.inf)
+        if cfg.double_q:
+            best = jnp.argmax(masked_next, axis=-1)        # online argmax
+            target_max = jnp.take_along_axis(
+                target_qs[1:], best[..., None], axis=-1)[..., 0]
+        else:
+            target_max = jnp.where(
+                avail[1:] > 0, target_qs[1:], -jnp.inf).max(axis=-1)
+
+        q_tot = self._unroll_mixer(
+            params["mixer"], chosen, hs[:-1], state[:-1], obs[:-1])
+        target_q_tot = self._unroll_mixer(
+            target_params["mixer"], target_max, target_hs[1:], state[1:],
+            obs[1:])
+
+        targets = reward + cfg.gamma * (1.0 - term) * target_q_tot
+        td = (q_tot - jax.lax.stop_gradient(targets)) * mask
+
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (weights[None, :] * td ** 2).sum() / denom
+
+        ep_mask = jnp.maximum(mask.sum(axis=0), 1.0)
+        info = {
+            "loss": loss,
+            "td_error_abs": jnp.abs(td).sum() / denom,
+            "q_taken_mean": (chosen.mean(axis=-1) * mask).sum() / denom,
+            "target_mean": (targets * mask).sum() / denom,
+            # per-episode priorities (Q9): masked mean |TD| per sample
+            "td_errors_abs": jnp.abs(td).sum(axis=0) / ep_mask,   # (B,)
+        }
+        return loss, info
+
+    # ------------------------------------------------------------------ train
+
+    def train(self, ls: LearnerState, batch: EpisodeBatch,
+              weights: jnp.ndarray, t_env: jnp.ndarray,
+              episode: jnp.ndarray
+              ) -> Tuple[LearnerState, Dict[str, jnp.ndarray]]:
+        """One importance-weighted QMIX update; hard target sync every
+        ``target_update_interval`` episodes (PyMARL convention, M8)."""
+        del t_env
+        opt = _make_optimizer(self.cfg)
+        grads, info = jax.grad(self._loss, has_aux=True)(
+            ls.params, ls.target_params, batch, weights)
+        info["grad_norm"] = optax.global_norm(grads)
+        updates, opt_state = opt.update(grads, ls.opt_state, ls.params)
+        params = optax.apply_updates(ls.params, updates)
+
+        episode = jnp.asarray(episode, jnp.int32)
+        sync = (episode - ls.last_target_update
+                ) >= self.cfg.target_update_interval
+        target_params = jax.tree.map(
+            lambda p, tp: jnp.where(sync, p, tp), params, ls.target_params)
+        return LearnerState(
+            params=params,
+            target_params=target_params,
+            opt_state=opt_state,
+            train_steps=ls.train_steps + 1,
+            last_target_update=jnp.where(sync, episode,
+                                         ls.last_target_update),
+        ), info
+
+
+LEARNER_REGISTRY = {"qmix_learner": QMixLearner}

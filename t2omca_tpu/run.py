@@ -1,0 +1,324 @@
+"""Experiment driver (C4): the train loop with the reference's cadences.
+
+Re-creates ``run``/``run_sequential``/``evaluate_sequential``
+(``/root/reference/per_run.py:20-309``) without sacred: config comes from the
+frozen-dataclass config tree (``config.py``), experiment identity is the
+unique token (``{name}_seed{seed}_{map}_{datetime}``, ``per_run.py:42``), and
+sinks are console + TensorBoard + JSONL (M9).
+
+Structure of one iteration (reference ``per_run.py:212-288``):
+rollout → insert → (if can_sample ∧ episode gate) sample → train → feed
+``|TD|+1e-6`` back as priorities (Q9) → cadenced test/log/checkpoint.
+Every device-side stage is a jitted pure function; the Python loop only
+sequences them and moves scalars to the logger.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from .components.episode_buffer import (BufferState, PrioritizedReplayBuffer,
+                                        ReplayBuffer)
+from .config import TrainConfig, sanity_check, unique_token
+from .controllers.basic_mac import MAC_REGISTRY
+from .envs.registry import make_env
+from .learners.qmix_learner import LEARNER_REGISTRY, LearnerState
+from .runners import RUNNER_REGISTRY
+from .runners.episode_runner import EpisodeRunner
+from .runners.parallel_runner import ParallelRunner, RunnerState
+from .utils.checkpoint import (find_checkpoint, load_checkpoint,
+                               save_checkpoint)
+from .utils.logging import Logger
+from .utils.timehelper import time_left, time_str
+
+
+@struct.dataclass
+class TrainState:
+    """The full checkpointable state (SURVEY.md §5(4): exact resume)."""
+
+    learner: LearnerState
+    runner: RunnerState
+    buffer: BufferState
+    episode: jnp.ndarray      # () int32 — episodes collected
+
+
+@dataclasses.dataclass
+class Experiment:
+    """Built components + jitted programs for one config."""
+
+    cfg: TrainConfig
+    env: object
+    mac: object
+    learner: object
+    runner: ParallelRunner
+    buffer: ReplayBuffer
+    episode_runner: EpisodeRunner
+
+    @classmethod
+    def build(cls, cfg: TrainConfig) -> "Experiment":
+        cfg = sanity_check(cfg)
+        env = make_env(cfg.env_args)
+        env_info = env.get_env_info()
+        mac = MAC_REGISTRY[cfg.mac].build(cfg, env_info)
+        learner = LEARNER_REGISTRY[cfg.learner].build(cfg, mac, env_info)
+        runner_cls = RUNNER_REGISTRY[cfg.runner]
+        runner = runner_cls(env, mac, cfg)
+        buf_cls = (PrioritizedReplayBuffer if cfg.replay.prioritized
+                   else ReplayBuffer)
+        buf_kw = dict(
+            capacity=cfg.replay.buffer_size,
+            episode_limit=cfg.env_args.episode_limit,
+            n_agents=env_info["n_agents"],
+            n_actions=env_info["n_actions"],
+            obs_dim=env_info["obs_shape"],
+            state_dim=env_info["state_shape"],
+        )
+        if cfg.replay.prioritized:
+            buf_kw.update(alpha=cfg.replay.per_alpha,
+                          beta0=cfg.replay.per_beta, t_max=cfg.t_max)
+        buffer = buf_cls(**buf_kw)
+        episode_runner = EpisodeRunner(env, mac, cfg)
+        return cls(cfg=cfg, env=env, mac=mac, learner=learner, runner=runner,
+                   buffer=buffer, episode_runner=episode_runner)
+
+    # ------------------------------------------------------------------ state
+
+    def init_train_state(self, seed: int) -> TrainState:
+        k_learner, k_runner = jax.random.split(jax.random.PRNGKey(seed))
+        return TrainState(
+            learner=self.learner.init_state(k_learner),
+            runner=self.runner.init_state(k_runner),
+            buffer=self.buffer.init(),
+            episode=jnp.zeros((), jnp.int32),
+        )
+
+    # ------------------------------------------------------------------ programs
+
+    def jitted_programs(self):
+        runner, buffer, learner, cfg = (self.runner, self.buffer,
+                                        self.learner, self.cfg)
+
+        rollout = jax.jit(runner.run, static_argnames="test_mode")
+        insert = jax.jit(buffer.insert_episode_batch)
+
+        def _train_iter(ts: TrainState, key: jax.Array, t_env: jnp.ndarray):
+            """sample → train → priority feedback, as one program."""
+            batch, idx, weights = buffer.sample(
+                ts.buffer, key, cfg.batch_size, t_env)
+            learner_state, info = learner.train(
+                ts.learner, batch, weights, t_env, ts.episode)
+            buf = buffer.update_priorities(
+                ts.buffer, idx, info["td_errors_abs"] + 1e-6)   # Q9
+            return ts.replace(learner=learner_state, buffer=buf), info
+
+        return rollout, insert, jax.jit(_train_iter)
+
+
+def _log_rollout_stats(logger: Logger, stats, t_env: int,
+                       prefix: str = "") -> None:
+    """Mean-aggregate a RolloutStats over the env axis and log with the
+    reference's key set (``parallel_runner.py:202-231``, SURVEY.md §5.5)."""
+    s = jax.device_get(stats)
+    n = max(len(np.atleast_1d(s.episode_return)), 1)
+    logger.log_stat(prefix + "return_mean",
+                    float(np.sum(s.episode_return)) / n, t_env)
+    logger.log_stat(prefix + "ep_length_mean",
+                    float(np.sum(s.episode_length)) / n, t_env)
+    t_per_ep = max(float(np.mean(s.episode_length)), 1.0)
+    for k in ("delay_reward", "overtime_penalty", "channel_utilization_rate",
+              "conflict_ratio"):
+        # reference sums per-step infos over the episode then means per ep;
+        # utilization/conflict are per-step rates so divide by length too
+        v = float(np.sum(getattr(s, k))) / n
+        if k in ("channel_utilization_rate", "conflict_ratio"):
+            v /= t_per_ep
+        logger.log_stat(prefix + k + "_mean", v, t_env)
+    for k in ("task_completion_rate", "task_completion_delay"):
+        logger.log_stat(prefix + k + "_mean",
+                        float(np.sum(getattr(s, k))) / n, t_env)
+    if not prefix:
+        logger.log_stat("epsilon", float(np.mean(s.epsilon)), t_env)
+
+
+def run(cfg: TrainConfig, logger: Optional[Logger] = None) -> TrainState:
+    """Top-level entry (reference ``run``, ``per_run.py:20-66``): set up the
+    unique token and sinks, then train (or evaluate and exit)."""
+    logger = logger or Logger()
+    cfg = sanity_check(cfg)
+    token = unique_token(cfg)
+    results_dir = os.path.join(cfg.local_results_path, token)
+    if cfg.use_tensorboard:
+        logger.setup_tb(os.path.join(
+            cfg.local_results_path, "tb_logs", token))
+    logger.setup_json(results_dir)
+    logger.console_logger.info(f"Experiment token: {token}")
+
+    exp = Experiment.build(cfg)
+    if cfg.evaluate or cfg.save_replay or cfg.save_animation:
+        return evaluate_sequential(exp, logger, results_dir)
+    return run_sequential(exp, logger, results_dir)
+
+
+def run_sequential(exp: Experiment, logger: Logger,
+                   results_dir: str) -> TrainState:
+    """The train loop (reference ``run_sequential``, ``per_run.py:106-289``)."""
+    cfg = exp.cfg
+    log = logger.console_logger
+    env_info = exp.env.get_env_info()
+    log.info(f"env_info: {env_info}")
+
+    ts = exp.init_train_state(cfg.seed)
+    rollout, insert, train_iter = exp.jitted_programs()
+    key = jax.random.PRNGKey(cfg.seed + 1)
+
+    t_env = 0
+    # ---- resume (reference :159-189, Q13: t_env cursor restored) ----
+    if cfg.checkpoint_path:
+        found = find_checkpoint(cfg.checkpoint_path, cfg.load_step)
+        if found is None:
+            log.info(f"no checkpoint found in {cfg.checkpoint_path}")
+        else:
+            dirname, step = found
+            ts = load_checkpoint(dirname, ts)
+            t_env = step
+            ts = ts.replace(runner=ts.runner.replace(
+                t_env=jnp.asarray(step, jnp.int32)))
+            log.info(f"resumed from {dirname} at t_env={step}")
+
+    model_dir = os.path.join(cfg.local_results_path, "models",
+                             os.path.basename(results_dir))
+
+    last_test_t = t_env - cfg.test_interval - 1
+    last_log_t = t_env
+    last_save_t = t_env if t_env else -cfg.save_model_interval - 1
+    start_time = last_time = time.time()
+    start_t = last_T = t_env
+    n_test_runs = max(1, cfg.test_nepisode // cfg.batch_size_run)
+    train_infos = []
+    train_stats_acc = []
+
+    while t_env <= cfg.t_max:
+        # ---------------- rollout (no grad by construction) ----------------
+        rs, batch, stats = rollout(ts.learner.params["agent"], ts.runner,
+                                   test_mode=False)
+        ts = ts.replace(runner=rs,
+                        buffer=insert(ts.buffer, batch),
+                        episode=ts.episode + cfg.batch_size_run)
+        t_env = int(jax.device_get(rs.t_env))
+        train_stats_acc.append(stats)
+
+        # ---------------- train gate (reference :220-238) ------------------
+        can = bool(jax.device_get(
+            exp.buffer.can_sample(ts.buffer, cfg.batch_size)))
+        episode = int(jax.device_get(ts.episode))
+        if can and episode >= cfg.accumulated_episodes:
+            key, k_sample = jax.random.split(key)
+            ts, info = train_iter(ts, k_sample, jnp.asarray(t_env))
+            train_infos.append(info)
+
+        # ---------------- test cadence (reference :240-256) ----------------
+        if (t_env - last_test_t) / cfg.test_interval >= 1.0:
+            log.info(f"t_env: {t_env} / {cfg.t_max}")
+            log.info(
+                f"Estimated time left: "
+                f"{time_left(last_time, last_T, t_env, cfg.t_max)}. "
+                f"Time passed: {time_str(time.time() - start_time)}")
+            last_time, last_T = time.time(), t_env
+
+            test_stats = []
+            for _ in range(n_test_runs):
+                rs, _, s = rollout(ts.learner.params["agent"], ts.runner,
+                                   test_mode=True)
+                ts = ts.replace(runner=rs)
+                test_stats.append(s)
+            merged = jax.tree.map(
+                lambda *xs: np.concatenate([np.atleast_1d(x) for x in xs]),
+                *test_stats)
+            _log_rollout_stats(logger, merged, t_env, prefix="test_")
+            last_test_t = t_env
+
+        # ---------------- save cadence (reference :265-279) ----------------
+        if cfg.save_model and (t_env - last_save_t) >= cfg.save_model_interval:
+            save_to = save_checkpoint(model_dir, t_env, ts)
+            log.info(f"Saving models to {save_to}")
+            last_save_t = t_env
+
+        # ---------------- log cadence (reference :283-286) ------------------
+        if (t_env - last_log_t) >= cfg.log_interval:
+            merged = jax.tree.map(
+                lambda *xs: np.concatenate([np.atleast_1d(x) for x in xs]),
+                *train_stats_acc)
+            _log_rollout_stats(logger, merged, t_env)
+            train_stats_acc = []
+            if train_infos:
+                last = jax.device_get(train_infos[-1])
+                for k in ("loss", "grad_norm", "td_error_abs",
+                          "q_taken_mean", "target_mean"):
+                    logger.log_stat(k, float(last[k]), t_env)
+                train_infos = []
+            logger.log_stat("episode", episode, t_env)
+            logger.print_recent_stats()
+            last_log_t = t_env
+
+    log.info("Finished Training")
+    return ts
+
+
+def evaluate_sequential(exp: Experiment, logger: Logger,
+                        results_dir: str) -> TrainState:
+    """Eval/replay/benchmark entry (reference ``evaluate_sequential``,
+    ``per_run.py:74-101``): greedy episodes on the single-env runner, with
+    optional replay (npz), animation (gif) and benchmark CSV export."""
+    cfg = exp.cfg
+    log = logger.console_logger
+    ts = exp.init_train_state(cfg.seed)
+    if cfg.checkpoint_path:
+        found = find_checkpoint(cfg.checkpoint_path, cfg.load_step)
+        if found is not None:
+            dirname, step = found
+            ts = load_checkpoint(dirname, ts)
+            log.info(f"loaded models from {dirname}")
+
+    er = exp.episode_runner
+    rs = er.init_state(jax.random.PRNGKey(cfg.seed + 2))
+    params = ts.learner.params["agent"]
+
+    trajs = []
+    returns = []
+    for ep in range(cfg.test_nepisode):
+        rs, batch, stats, traj = er.run(params, rs, test_mode=True,
+                                        capture_trajectory=True)
+        trajs.append(traj)
+        returns.append(float(np.sum(jax.device_get(stats.episode_return))))
+    log.info(f"eval over {len(returns)} episodes: "
+             f"return_mean={np.mean(returns):.3f} ± {np.std(returns):.3f}")
+    logger.log_stat("test_return_mean", float(np.mean(returns)), 0)
+
+    if cfg.save_replay:
+        p = er.save_replay(trajs[0], os.path.join(results_dir, "replay.npz"))
+        log.info(f"replay saved to {p}")
+    if cfg.save_animation:
+        p = er.save_animation(trajs[0],
+                              os.path.join(results_dir, "animation.gif"))
+        log.info(f"animation saved to {p}")
+    if cfg.benchmark_mode:
+        # reference exports CSVs only in benchmark mode (per_run.py:96-101)
+        p = er.benchmark_csv(trajs, os.path.join(results_dir,
+                                                 "benchmark.csv"))
+        log.info(f"benchmark CSV saved to {p}")
+    return ts
+
+
+if __name__ == "__main__":          # `python -m t2omca_tpu.run train ...`
+    import sys
+
+    from .__main__ import main
+    sys.exit(main())

@@ -1,0 +1,7 @@
+from .episode_runner import EpisodeRunner
+from .parallel_runner import ParallelRunner, RolloutStats, RunnerState
+
+RUNNER_REGISTRY = {"parallel": ParallelRunner, "episode": EpisodeRunner}
+
+__all__ = ["ParallelRunner", "EpisodeRunner", "RunnerState", "RolloutStats",
+           "RUNNER_REGISTRY"]

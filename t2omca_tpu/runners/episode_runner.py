@@ -1,0 +1,167 @@
+"""Single-env episode runner (M5) — evaluation, animation, benchmark export.
+
+The reference delegates render/animation/benchmark runs to a single-env
+``EpisodeRunner`` clone (``/root/reference/parallel_runner.py:49-52,104-105``;
+contract in SURVEY.md §2.3 M5: ``run(test_mode, render, save_animation,
+benchmark_mode)`` returning per-episode info dicts, plus ``save_replay`` /
+``save_animation``).
+
+TPU design: rather than a host-side Python step loop with live matplotlib
+rendering, the episode runs as the same fused scan as ``ParallelRunner`` with
+``B = 1``, and the *same scan* emits the visualization trajectory (AGV
+positions, serving MECs, ACKs) as extra scan outputs — so the exported
+trajectory is exactly the episode whose batch/stats are returned. One device
+program + one host drawing pass instead of ``episode_limit`` alternations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..config import TrainConfig
+from ..controllers.basic_mac import BasicMAC
+from ..envs.mec_offload import MultiAgvOffloadingEnv
+from .parallel_runner import ParallelRunner, RunnerState
+
+
+@dataclasses.dataclass(frozen=True)
+class EpisodeRunner:
+    """Batch-1 runner reusing the ParallelRunner program, plus viz capture."""
+
+    env: MultiAgvOffloadingEnv
+    mac: BasicMAC
+    cfg: TrainConfig
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "_inner",
+            ParallelRunner(self.env, self.mac,
+                           self.cfg.replace(batch_size_run=1)))
+
+    @property
+    def batch_size(self) -> int:
+        return 1
+
+    def get_env_info(self) -> Dict[str, int]:
+        return self.env.get_env_info()
+
+    def init_state(self, key: jax.Array) -> RunnerState:
+        return self._inner.init_state(key)
+
+    # ------------------------------------------------------------------ run
+
+    def run(self, params, rs: RunnerState, test_mode: bool = True,
+            capture_trajectory: bool = False):
+        """→ (rs', batch, stats[, trajectory]). ``trajectory`` is a host-side
+        dict of per-step arrays for rendering/benchmark export, emitted by
+        the same scan that produced ``batch`` (no re-run, no drift)."""
+        if not capture_trajectory:
+            return self._inner.run(params, rs, test_mode=test_mode)
+        rs2, batch, stats, viz = self._inner.run(
+            params, rs, test_mode=test_mode, capture=True)
+        return rs2, batch, stats, self._to_host(viz)
+
+    def _to_host(self, viz) -> Dict[str, np.ndarray]:
+        """Device ``(T, B=1, ...)`` viz pytree → host dict of ``(T, ...)``."""
+        viz = jax.device_get(viz)
+        info = viz["info"]
+        lane = lambda x: np.asarray(x)[:, 0]
+        return {
+            "pos": lane(viz["pos"]),
+            "mec_index": lane(viz["mec_index"]),
+            "actions": lane(viz["actions"]),
+            "acks": lane(viz["acks"]),
+            "reward": lane(viz["reward"]),
+            "delay_reward": lane(info.delay_reward),
+            "overtime_penalty": lane(info.overtime_penalty),
+            "channel_utilization_rate": lane(info.channel_utilization_rate),
+            "conflict_ratio": lane(info.conflict_ratio),
+            "task_completion_rate": lane(info.task_completion_rate),
+            "task_completion_delay": lane(info.task_completion_delay),
+            "mec_positions": np.asarray(self.env.mec_positions()),
+            "radius": np.asarray(self.env.cfg.communication_range_m),
+        }
+
+    # ------------------------------------------------------------------ export
+
+    @staticmethod
+    def save_replay(traj: Dict[str, np.ndarray], path: str) -> str:
+        """Replay = the recorded trajectory arrays (npz). Reference
+        ``save_replay`` hook (``parallel_runner.py:68-69``)."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.savez_compressed(path, **{k: v for k, v in traj.items()})
+        return path
+
+    @staticmethod
+    def save_animation(traj: Dict[str, np.ndarray], path: str,
+                       fps: int = 10) -> Optional[str]:
+        """Render the MEC deployment + AGV teleport trajectory to a gif
+        (capability of ``draw_mec_deployment``/``save_animation``,
+        ``environment_multi_mec.py:447-471``, ``parallel_runner.py:70-72``)."""
+        try:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+            from matplotlib import animation
+        except Exception:   # matplotlib absent → gracefully skip (env gate)
+            return None
+
+        mecs, r = traj["mec_positions"], float(traj["radius"])
+        fig, ax = plt.subplots(figsize=(8, 4))
+        for (x, y) in mecs:
+            ax.add_patch(plt.Circle((x, y), r, fill=False, ls="--"))
+            ax.plot([x], [y], marker="s", ms=8)
+        scat = ax.scatter(traj["pos"][0, :, 0], traj["pos"][0, :, 1])
+        ax.set_xlim(-r, mecs[:, 0].max() + r)
+        ax.set_ylim(-r, 3 * r)
+        ax.set_aspect("equal")
+
+        def update(i):
+            scat.set_offsets(traj["pos"][i])
+            colors = np.where(traj["acks"][i] == -1, "red",
+                              np.where(traj["acks"][i] == 1, "green", "gray"))
+            scat.set_color(colors)
+            ax.set_title(f"slot {i}  reward {traj['reward'][i]:.1f}")
+            return (scat,)
+
+        anim = animation.FuncAnimation(
+            fig, update, frames=len(traj["pos"]), blit=False)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        anim.save(path, writer=animation.PillowWriter(fps=fps))
+        plt.close(fig)
+        return path
+
+    @staticmethod
+    def benchmark_csv(trajs: List[Dict[str, np.ndarray]],
+                      path: str) -> Optional[str]:
+        """Benchmark-mode episode export to CSV (reference writes per-episode
+        CSVs via pandas, ``/root/reference/per_run.py:96-101``). Gated on
+        pandas availability like the animation path is on matplotlib."""
+        try:
+            import pandas as pd
+        except Exception:
+            return None
+
+        rows = []
+        for ep, traj in enumerate(trajs):
+            rows.append({
+                "episode": ep,
+                "return": float(traj["reward"].sum()),
+                "delay_reward": float(traj["delay_reward"].sum()),
+                "overtime_penalty": float(traj["overtime_penalty"].sum()),
+                "channel_utilization_rate":
+                    float(traj["channel_utilization_rate"].mean()),
+                "conflict_ratio": float(traj["conflict_ratio"].mean()),
+                "task_completion_rate":
+                    float(traj["task_completion_rate"][-1]),
+                "task_completion_delay":
+                    float(traj["task_completion_delay"][-1]),
+            })
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        pd.DataFrame(rows).to_csv(path, index=False)
+        return path

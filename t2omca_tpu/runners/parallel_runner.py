@@ -1,0 +1,173 @@
+"""Vectorized rollout runner — the TPU replacement for the subprocess farm.
+
+Re-creates ``ParallelRunner`` (``/root/reference/parallel_runner.py:13-287``,
+C3) with the Anakin/PureJaxRL pattern (SURVEY.md §7.1): instead of
+``batch_size_run`` daemon processes exchanging pickled NumPy over Pipes, the
+pure-functional env is ``jax.vmap``-ed over the env axis and ``lax.scan``-ed
+over episode time, with MAC action selection fused into the same XLA program.
+"runner↔env communication" is a function call inside one compiled program —
+the entire IPC tier (``env_worker``, ``CloudpickleWrapper``, the five-message
+Pipe protocol, ``:234-287``) has no equivalent because nothing crosses a
+process boundary.
+
+Semantics preserved:
+
+* per-env independent streams: worker ``i`` gets ``seed + i`` (Q8) → here
+  ``jax.random.split`` of a per-rollout key, one subkey per env lane;
+* per-env Welford obs normalizers persist across episodes (reference: one
+  per subprocess lifetime; here carried in ``RunnerState`` and threaded back
+  into ``env.reset``) and update even in test mode (Q4);
+* actions recorded into the episode at the pre-step slot (Q15);
+* time-limit termination recorded as non-terminal for bootstrapping (Q7):
+  ``terminated & ~info.episode_limit``;
+* stats summed over envs and episodes, logged as ``<k>_mean = v/n`` with the
+  same keys (``parallel_runner.py:202-231``, §5.5 metric contract);
+* epsilon logged from the selector schedule (``:217-218``).
+
+The env in this build terminates only at ``episode_limit``, so every lane
+runs exactly ``T`` slots and ``filled`` is all-ones — the general masks are
+still produced for parity with the M4 scheme.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..components.episode_buffer import EpisodeBatch
+from ..config import TrainConfig
+from ..controllers.basic_mac import BasicMAC
+from ..envs.mec_offload import EnvState, MultiAgvOffloadingEnv
+
+
+@struct.dataclass
+class RunnerState:
+    """Cross-episode carried state (one vmap lane = one reference worker)."""
+
+    env_states: EnvState      # batched (B, ...) — holds the persistent norms
+    key: jnp.ndarray          # PRNG key
+    t_env: jnp.ndarray        # () int32 — global env-step cursor
+
+
+@struct.dataclass
+class RolloutStats:
+    """Per-rollout aggregates (summed over envs, reference ``:202-219``)."""
+
+    episode_return: jnp.ndarray            # (B,)
+    episode_length: jnp.ndarray            # (B,)
+    delay_reward: jnp.ndarray              # (B,) summed over t
+    overtime_penalty: jnp.ndarray          # (B,)
+    channel_utilization_rate: jnp.ndarray  # (B,) summed over t
+    conflict_ratio: jnp.ndarray            # (B,)
+    task_completion_rate: jnp.ndarray      # (B,) terminal-step value
+    task_completion_delay: jnp.ndarray     # (B,)
+    epsilon: jnp.ndarray                   # ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelRunner:
+    env: MultiAgvOffloadingEnv
+    mac: BasicMAC
+    cfg: TrainConfig
+
+    @property
+    def batch_size(self) -> int:
+        return self.cfg.batch_size_run
+
+    def get_env_info(self) -> Dict[str, int]:
+        return self.env.get_env_info()
+
+    # ------------------------------------------------------------------ state
+
+    def init_state(self, key: jax.Array) -> RunnerState:
+        """Initial env states; norms start fresh (as at subprocess spawn)."""
+        key, k_reset = jax.random.split(key)
+        states, *_ = jax.vmap(self.env.reset)(
+            jax.random.split(k_reset, self.batch_size))
+        return RunnerState(env_states=states, key=key,
+                           t_env=jnp.zeros((), jnp.int32))
+
+    # ------------------------------------------------------------------ rollout
+
+    def run(self, params, rs: RunnerState, test_mode: bool = False,
+            capture: bool = False):
+        """One synchronous batched episode. Pure → jittable; ``test_mode``
+        (greedy selection) and ``capture`` are static Python bools.
+
+        With ``capture=True`` a fourth return value carries the per-step
+        visualization fields (pre-step AGV positions, serving MECs, ACKs) as
+        ``(T, B, ...)`` arrays — the same scan emits them, so the trajectory
+        is exactly the episode in the returned batch (no re-run, no drift)."""
+        b, t_len = self.batch_size, self.env.cfg.episode_limit
+        key, k_reset, k_scan = jax.random.split(rs.key, 3)
+
+        # reset every lane, carrying each lane's Welford normalizer (Q4)
+        reset_keys = jax.random.split(k_reset, b)
+        env_states, obs, gstate, avail = jax.vmap(self.env.reset)(
+            reset_keys, rs.env_states.norm)
+
+        hidden = self.mac.init_hidden(b)
+
+        def step_fn(carry, key_t):
+            env_states, obs, gstate, avail, hidden, t_env = carry
+            k_act, k_env = jax.random.split(key_t)
+            actions, hidden, eps = self.mac.select_actions(
+                params, obs, avail, hidden, k_act, t_env,
+                test_mode=test_mode)
+            # Q15: the action is recorded with the pre-step observation
+            pre = (obs, gstate, avail, actions)
+            viz = ((env_states.pos, env_states.mec_index)
+                   if capture else None)
+            env_states, reward, terminated, info, obs, gstate, avail = \
+                jax.vmap(self.env.step)(
+                    env_states, actions, jax.random.split(k_env, b))
+            env_terminal = terminated & ~info.episode_limit        # Q7
+            ys = (pre, reward, env_terminal, info, eps,
+                  (viz + (env_states.last_ack,)) if capture else ())
+            t_env = t_env + jnp.where(jnp.asarray(test_mode), 0, b)
+            return (env_states, obs, gstate, avail, hidden, t_env), ys
+
+        carry = (env_states, obs, gstate, avail, hidden, rs.t_env)
+        carry, ys = jax.lax.scan(step_fn, carry, jax.random.split(k_scan, t_len))
+        env_states, last_obs, last_gstate, last_avail, _, t_env = carry
+        (pre, reward, env_terminal, info, eps, viz_seq) = ys
+        obs_seq, gstate_seq, avail_seq, action_seq = pre
+
+        # (T, B, ...) → (B, T, ...), with the bootstrap step appended
+        bt = lambda x: jnp.swapaxes(x, 0, 1)
+        cat_last = lambda seq, last: jnp.concatenate(
+            [bt(seq), last[:, None]], axis=1)
+
+        batch = EpisodeBatch(
+            obs=cat_last(obs_seq, last_obs),
+            state=cat_last(gstate_seq, last_gstate),
+            avail_actions=cat_last(avail_seq, last_avail),
+            actions=bt(action_seq),
+            reward=bt(reward),
+            terminated=bt(env_terminal),
+            filled=jnp.ones((b, t_len), bool),
+        )
+
+        stats = RolloutStats(
+            episode_return=bt(reward).sum(axis=1),
+            episode_length=jnp.full((b,), t_len, jnp.float32),
+            delay_reward=bt(info.delay_reward).sum(axis=1),
+            overtime_penalty=bt(info.overtime_penalty).sum(axis=1),
+            channel_utilization_rate=bt(
+                info.channel_utilization_rate).sum(axis=1),
+            conflict_ratio=bt(info.conflict_ratio).sum(axis=1),
+            task_completion_rate=bt(info.task_completion_rate)[:, -1],
+            task_completion_delay=bt(info.task_completion_delay)[:, -1],
+            epsilon=eps[-1],
+        )
+        new_rs = RunnerState(env_states=env_states, key=key, t_env=t_env)
+        if capture:
+            pos_seq, mec_seq, ack_seq = viz_seq
+            viz = {"pos": pos_seq, "mec_index": mec_seq, "acks": ack_seq,
+                   "actions": action_seq, "reward": reward, "info": info}
+            return new_rs, batch, stats, viz
+        return new_rs, batch, stats

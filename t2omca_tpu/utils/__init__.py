@@ -1,0 +1,4 @@
+from .logging import Logger
+from .timehelper import time_left, time_str
+
+__all__ = ["Logger", "time_left", "time_str"]

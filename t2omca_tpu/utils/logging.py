@@ -1,0 +1,84 @@
+"""Logger facade (M9).
+
+Contract from the call sites (``/root/reference/per_run.py:8,29,45-53``):
+wraps a console logger; ``setup_tb(dir)``; ``log_stat(key, value, t)``;
+``print_recent_stats()``; exposes ``.console_logger``. The sacred observer
+(``setup_sacred``) has no equivalent here — the experiment registry is the
+run directory plus TensorBoard; a ``log_json`` sink writes the same scalars
+as JSONL for offline analysis (replacing sacred's FileStorageObserver).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from collections import defaultdict
+from typing import Optional
+
+
+def get_console_logger(name: str = "t2omca") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "[%(levelname)s %(asctime)s] %(name)s %(message)s", "%H:%M:%S"))
+        logger.addHandler(h)
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+    return logger
+
+
+class Logger:
+    def __init__(self, console_logger: Optional[logging.Logger] = None):
+        self.console_logger = console_logger or get_console_logger()
+        self.stats = defaultdict(list)       # key -> [(t, value)]
+        self._tb = None
+        self._jsonl = None
+
+    # ---- sinks -----------------------------------------------------------
+    def setup_tb(self, dirname: str) -> None:
+        """TensorBoard via torch's bundled writer (the image has torch;
+        gated so a torch-free install still runs)."""
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except Exception:
+            self.console_logger.warning(
+                "tensorboard writer unavailable; TB logging disabled")
+            return
+        os.makedirs(dirname, exist_ok=True)
+        self._tb = SummaryWriter(log_dir=dirname)
+
+    def setup_json(self, dirname: str) -> None:
+        os.makedirs(dirname, exist_ok=True)
+        self._jsonl = open(os.path.join(dirname, "metrics.jsonl"), "a")
+
+    # ---- scalar API ------------------------------------------------------
+    def log_stat(self, key: str, value, t: int) -> None:
+        value = float(value)
+        self.stats[key].append((t, value))
+        if self._tb is not None:
+            self._tb.add_scalar(key, value, t)
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(
+                {"key": key, "value": value, "t": t}) + "\n")
+            self._jsonl.flush()
+
+    def print_recent_stats(self) -> None:
+        """Mirrors the reference's periodic stat dump
+        (``per_run.py:283-286``): latest value per key at the newest t."""
+        if not self.stats:
+            return
+        t = max(ts[-1][0] for ts in self.stats.values())
+        items = [f"t_env: {t}"]
+        for k in sorted(self.stats):
+            window = self.stats[k][-5:]
+            mean = sum(v for _, v in window) / len(window)
+            items.append(f"{k}: {mean:.4f}")
+        self.console_logger.info("Recent stats | " + " | ".join(items))
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()
+        if self._jsonl is not None:
+            self._jsonl.close()

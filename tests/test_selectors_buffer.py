@@ -1,0 +1,155 @@
+"""Unit tests: action selectors, schedules, and replay buffers (L3/L4
+components; SURVEY.md §4 recommends pure-function unit tests per branch)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from t2omca_tpu.components.action_selectors import (EpsilonGreedySelector,
+                                                    masked_argmax,
+                                                    random_avail)
+from t2omca_tpu.components.episode_buffer import (EpisodeBatch,
+                                                  PrioritizedReplayBuffer,
+                                                  ReplayBuffer)
+from t2omca_tpu.components.schedules import DecayThenFlatSchedule
+
+
+# ---------------------------------------------------------------- schedules
+
+def test_schedule_linear_decay_then_flat():
+    s = DecayThenFlatSchedule(1.0, 0.05, 100)
+    assert float(s.eval(0)) == pytest.approx(1.0)
+    assert float(s.eval(50)) == pytest.approx(0.525)
+    assert float(s.eval(100)) == pytest.approx(0.05)
+    assert float(s.eval(10_000)) == pytest.approx(0.05)
+
+
+# ---------------------------------------------------------------- selectors
+
+def test_masked_argmax_respects_availability():
+    q = jnp.array([[3.0, 2.0, 1.0]])
+    avail = jnp.array([[0, 1, 1]])
+    assert int(masked_argmax(q, avail)[0]) == 1
+
+
+def test_random_avail_only_picks_available():
+    avail = jnp.array([[1, 0, 1, 0]])
+    picks = {int(random_avail(jax.random.PRNGKey(i), avail)[0])
+             for i in range(50)}
+    assert picks <= {0, 2} and len(picks) == 2
+
+
+def test_epsilon_greedy_test_mode_is_greedy():
+    sel = EpsilonGreedySelector(DecayThenFlatSchedule(1.0, 0.05, 100))
+    q = jnp.array([[0.1, 5.0, 0.2]])
+    avail = jnp.ones((1, 3), jnp.int32)
+    for i in range(20):
+        a, eps = sel.select(jax.random.PRNGKey(i), q, avail,
+                            jnp.asarray(0), test_mode=True)
+        assert int(a[0]) == 1
+        assert float(eps) == 0.0
+
+
+def test_epsilon_greedy_explores_at_full_epsilon():
+    sel = EpsilonGreedySelector(DecayThenFlatSchedule(1.0, 1.0, 100))
+    q = jnp.array([[0.1, 5.0, 0.2]])
+    avail = jnp.ones((1, 3), jnp.int32)
+    picks = {int(sel.select(jax.random.PRNGKey(i), q, avail,
+                            jnp.asarray(0))[0][0]) for i in range(60)}
+    assert picks == {0, 1, 2}   # uniform over available actions
+
+
+# ---------------------------------------------------------------- buffers
+
+def _make_batch(b, t=3, a=2, n_act=3, obs=4, state=5, seed=0):
+    rng = np.random.default_rng(seed)
+    return EpisodeBatch(
+        obs=jnp.asarray(rng.normal(size=(b, t + 1, a, obs)), jnp.float32),
+        state=jnp.asarray(rng.normal(size=(b, t + 1, state)), jnp.float32),
+        avail_actions=jnp.ones((b, t + 1, a, n_act), jnp.int32),
+        actions=jnp.asarray(rng.integers(0, n_act, (b, t, a)), jnp.int32),
+        reward=jnp.asarray(rng.normal(size=(b, t)), jnp.float32),
+        terminated=jnp.zeros((b, t), bool),
+        filled=jnp.ones((b, t), bool),
+    )
+
+
+def _buf(cls=ReplayBuffer, cap=5, **kw):
+    return cls(capacity=cap, episode_limit=3, n_agents=2, n_actions=3,
+               obs_dim=4, state_dim=5, **kw)
+
+
+def test_ring_insert_and_wraparound():
+    buf = _buf()
+    s = buf.init()
+    s = buf.insert_episode_batch(s, _make_batch(3, seed=1))
+    assert int(s.episodes_in_buffer) == 3 and int(s.insert_pos) == 3
+    s = buf.insert_episode_batch(s, _make_batch(3, seed=2))
+    assert int(s.episodes_in_buffer) == 5      # capped at capacity
+    assert int(s.insert_pos) == 1              # wrapped
+    # slot 0 now holds the last episode of the second batch
+    np.testing.assert_allclose(
+        np.asarray(s.storage.reward[0]), np.asarray(_make_batch(3, seed=2).reward[2]))
+
+
+def test_can_sample_gate():
+    buf = _buf()
+    s = buf.init()
+    assert not bool(buf.can_sample(s, 2))
+    s = buf.insert_episode_batch(s, _make_batch(2))
+    assert bool(buf.can_sample(s, 2))
+
+
+def test_uniform_sample_returns_valid_indices_without_replacement():
+    buf = _buf()
+    s = buf.insert_episode_batch(buf.init(), _make_batch(4))
+    batch, idx, w = buf.sample(s, jax.random.PRNGKey(0), 3)
+    idx = np.asarray(idx)
+    assert (idx >= 0).all() and (idx < 4).all()
+    assert len(set(idx.tolist())) == 3          # without replacement
+    np.testing.assert_allclose(np.asarray(w), 1.0)
+    assert batch.obs.shape == (3, 4, 2, 4)
+
+
+def test_per_prioritized_sampling_prefers_high_priority():
+    buf = _buf(PrioritizedReplayBuffer, cap=8, alpha=1.0, beta0=0.4,
+               t_max=100)
+    s = buf.insert_episode_batch(buf.init(), _make_batch(8))
+    # one episode dominates the priority mass
+    s = buf.update_priorities(s, jnp.arange(8),
+                              jnp.asarray([100.0] + [0.01] * 7))
+    counts = np.zeros(8)
+    for i in range(20):
+        _, idx, _ = buf.sample(s, jax.random.PRNGKey(i), 4, t_env=0)
+        for j in np.asarray(idx):
+            counts[j] += 1
+    assert counts[0] == counts.max() and counts[0] >= 0.8 * counts.sum()
+
+
+def test_per_importance_weights_anneal_to_one():
+    buf = _buf(PrioritizedReplayBuffer, cap=4, alpha=0.6, beta0=0.4,
+               t_max=100)
+    s = buf.insert_episode_batch(buf.init(), _make_batch(4))
+    s = buf.update_priorities(s, jnp.arange(4),
+                              jnp.asarray([1.0, 2.0, 3.0, 4.0]))
+    _, i0, w0 = buf.sample(s, jax.random.PRNGKey(0), 4, t_env=0)
+    _, i1, w1 = buf.sample(s, jax.random.PRNGKey(0), 4, t_env=100)
+    # weights are max-normalized at both ends of the anneal
+    assert float(np.max(w0)) == pytest.approx(1.0)
+    assert float(np.max(w1)) == pytest.approx(1.0)
+    # importance correction is anti-monotone in priority: the lower-priority
+    # sampled episode carries the larger weight
+    pri = np.asarray(s.priorities)
+    w1, i1 = np.asarray(w1), np.asarray(i1)
+    order = np.argsort(pri[i1])
+    assert (np.diff(w1[order]) <= 1e-6).all()
+
+
+def test_per_new_episodes_get_max_priority():
+    buf = _buf(PrioritizedReplayBuffer, cap=4, alpha=1.0, beta0=0.4,
+               t_max=100)
+    s = buf.insert_episode_batch(buf.init(), _make_batch(2))
+    s = buf.update_priorities(s, jnp.arange(2), jnp.asarray([5.0, 1.0]))
+    s = buf.insert_episode_batch(s, _make_batch(1))
+    assert float(s.priorities[2]) == pytest.approx(5.0)   # running max
