@@ -1,0 +1,122 @@
+"""Benchmark: fused rollout throughput at the north-star config.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures env-steps/sec of the jitted rollout program (vmapped env + MAC
+action selection + episode-batch emission fused into one XLA program) at the
+BASELINE.json north-star scale point: 64 AGVs × 8 MECs × 1024 parallel envs,
+d_model 256 agent network. ``vs_baseline`` is the ratio to the 50,000
+env-steps/s/chip target (the reference publishes no numbers of its own —
+BASELINE.md).
+
+Flags:
+  --smoke       tiny CPU config (CI validation of the bench harness itself)
+  --envs N      override the env-batch size
+  --steps N     override episode_limit for the timed program
+  --iters N     timed repetitions (median reported)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--envs", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--iters", type=int, default=3)
+    args = ap.parse_args()
+
+    if args.smoke:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+
+    from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,
+                                   TrainConfig, sanity_check)
+    from t2omca_tpu.run import Experiment
+
+    if args.smoke:
+        n_envs = args.envs or 8
+        steps = args.steps or 8
+        cfg = sanity_check(TrainConfig(
+            batch_size_run=n_envs,
+            env_args=EnvConfig(agv_num=4, mec_num=2, num_channels=2,
+                               episode_limit=steps),
+            model=ModelConfig(emb=16, heads=2, depth=1, mixer_emb=16,
+                              mixer_heads=2, mixer_depth=1),
+            replay=ReplayConfig(buffer_size=16),
+        ))
+    else:
+        # north-star scale point (BASELINE.json configs[2]): 64 AGVs × 8 MEC,
+        # 1024 envs, d_model 256. episode_limit is shortened for the timed
+        # program (throughput is per-step; the full 150-slot episode batch at
+        # entity obs 64×576 would exceed single-chip HBM — the training
+        # config shards it over the data axis instead).
+        n_envs = args.envs or 1024
+        steps = args.steps or 32
+        cfg = sanity_check(TrainConfig(
+            batch_size_run=n_envs,
+            env_args=EnvConfig(agv_num=64, mec_num=8, num_channels=8,
+                               episode_limit=steps),
+            model=ModelConfig(emb=256, heads=4, depth=2, mixer_emb=256,
+                              mixer_heads=4, mixer_depth=2,
+                              standard_heads=True, dtype="bfloat16"),
+            replay=ReplayConfig(buffer_size=4, store_dtype="bfloat16"),
+        ))
+
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(0)
+    rollout = jax.jit(exp.runner.run, static_argnames="test_mode")
+    params = ts.learner.params["agent"]
+
+    import numpy as np
+
+    def _sync(x):
+        # device→host fetch: the only reliable barrier under the axon remote
+        # tunnel, where block_until_ready on async futures returns early
+        return float(np.asarray(x))
+
+    # compile + warm-up (two runs: tunnel queues make the first timed run
+    # unrepresentative)
+    t0 = time.perf_counter()
+    rs, batch, stats = rollout(params, ts.runner, test_mode=False)
+    _sync(batch.reward[0, 0])
+    compile_s = time.perf_counter() - t0
+    rs, batch, stats = rollout(params, rs, test_mode=False)
+    _sync(batch.reward[0, 0])
+    print(f"# compile+first-run: {compile_s:.1f}s  "
+          f"devices={jax.devices()}", file=sys.stderr)
+
+    times = []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        rs, batch, stats = rollout(params, rs, test_mode=False)
+        _sync(batch.reward[0, 0])
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    dt = times[len(times) // 2]
+    env_steps = cfg.batch_size_run * cfg.env_args.episode_limit
+    rate = env_steps / dt
+    print(f"# median rollout: {dt * 1e3:.1f}ms for {env_steps} env-steps "
+          f"({n_envs} envs × {steps} slots, {cfg.env_args.agv_num} AGVs)",
+          file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "env_steps_per_sec",
+        "value": round(rate, 1),
+        "unit": "env-steps/s/chip",
+        "vs_baseline": round(rate / 50_000.0, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,10 +76,11 @@ class BufferState:
 
 
 def _zeros_like_episode(n_agents: int, n_actions: int, obs_dim: int,
-                        state_dim: int, t: int, batch: int) -> EpisodeBatch:
+                        state_dim: int, t: int, batch: int,
+                        store_dtype=jnp.float32) -> EpisodeBatch:
     return EpisodeBatch(
-        obs=jnp.zeros((batch, t + 1, n_agents, obs_dim), jnp.float32),
-        state=jnp.zeros((batch, t + 1, state_dim), jnp.float32),
+        obs=jnp.zeros((batch, t + 1, n_agents, obs_dim), store_dtype),
+        state=jnp.zeros((batch, t + 1, state_dim), store_dtype),
         avail_actions=jnp.zeros((batch, t + 1, n_agents, n_actions), jnp.int32),
         actions=jnp.zeros((batch, t, n_agents), jnp.int32),
         reward=jnp.zeros((batch, t), jnp.float32),
@@ -99,12 +100,14 @@ class ReplayBuffer:
     n_actions: int
     obs_dim: int
     state_dim: int
+    store_dtype: str = "float32"   # obs/state storage dtype (HBM budget)
 
     def init(self) -> BufferState:
         return BufferState(
             storage=_zeros_like_episode(
                 self.n_agents, self.n_actions, self.obs_dim, self.state_dim,
-                self.episode_limit, self.capacity),
+                self.episode_limit, self.capacity,
+                jnp.dtype(self.store_dtype)),
             insert_pos=jnp.zeros((), jnp.int32),
             episodes_in_buffer=jnp.zeros((), jnp.int32),
             priorities=jnp.zeros((self.capacity,), jnp.float32),

@@ -65,6 +65,7 @@ class ModelConfig:
     qmix_pos_func_beta: float = 1.0
     use_orthogonal: bool = False
     standard_heads: bool = False          # perf mode: per-head dim = emb//heads (quirk Q1 off)
+    dtype: str = "float32"                # compute dtype: float32 | bfloat16 (perf mode)
     # entity counts: filled from env info when 0
     n_entities_obs: int = 0
     n_entities_state: int = 0
@@ -77,6 +78,10 @@ class ReplayConfig:
     prioritized: bool = True
     per_alpha: float = 0.6
     per_beta: float = 0.4
+    # storage dtype for the big obs/state arrays in episode batches and the
+    # replay ring (HBM is the budget; bf16 halves it — the TPU analog of the
+    # reference's buffer_cpu_only escape hatch)
+    store_dtype: str = "float32"          # float32 | bfloat16
 
 
 @dataclass(frozen=True)

@@ -56,6 +56,7 @@ class BasicMAC:
             noisy=cfg.action_selector == "noisy-new",
             standard_heads=cfg.model.standard_heads,
             use_orthogonal=cfg.model.use_orthogonal,
+            dtype=jnp.dtype(cfg.model.dtype),
         )
         schedule = DecayThenFlatSchedule(
             cfg.epsilon_start, cfg.epsilon_finish, cfg.epsilon_anneal_time)

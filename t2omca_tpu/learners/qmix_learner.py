@@ -86,6 +86,7 @@ class QMixLearner:
             state_entity_mode=state_entity_mode,
             standard_heads=cfg.model.standard_heads,
             use_orthogonal=cfg.model.use_orthogonal,
+            dtype=jnp.dtype(cfg.model.dtype),
         )
         return cls(mac=mac, mixer=mixer, cfg=cfg,
                    obs_dim=env_info["obs_shape"],
@@ -151,9 +152,10 @@ class QMixLearner:
     def _loss(self, params, target_params, batch: EpisodeBatch,
               weights: jnp.ndarray) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
         cfg = self.cfg
-        # time-major views
-        obs = jnp.swapaxes(batch.obs, 0, 1)               # (T+1, B, A, O)
-        state = jnp.swapaxes(batch.state, 0, 1)           # (T+1, B, S)
+        # time-major views; obs/state may be stored bf16 (ReplayConfig
+        # store_dtype) — lift back to f32 for the loss math
+        obs = jnp.swapaxes(batch.obs, 0, 1).astype(jnp.float32)
+        state = jnp.swapaxes(batch.state, 0, 1).astype(jnp.float32)
         avail = jnp.swapaxes(batch.avail_actions, 0, 1)   # (T+1, B, A, n)
         actions = jnp.swapaxes(batch.actions, 0, 1)       # (T, B, A)
         reward = jnp.swapaxes(batch.reward, 0, 1)         # (T, B)

@@ -37,15 +37,16 @@ class TransformerAgent(nn.Module):
     noisy: bool = False      # action_selector == "noisy-new" (transf_agent.py:37-39)
     standard_heads: bool = False
     use_orthogonal: bool = False
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, inputs: jax.Array, hidden_state: jax.Array,
                  deterministic: bool = True) -> Tuple[jax.Array, jax.Array]:
         b, a, _ = inputs.shape
         x = inputs.reshape(b * a, self.n_entities, self.feat_dim)
-        h = hidden_state.reshape(b * a, 1, self.emb)
+        h = hidden_state.reshape(b * a, 1, self.emb).astype(self.dtype)
 
-        embs = nn.Dense(self.emb, name="feat_embedding",
+        embs = nn.Dense(self.emb, name="feat_embedding", dtype=self.dtype,
                         kernel_init=orthogonal_or_default(self.use_orthogonal))(x)
 
         # hidden token prepended at position 0 (transf_agent.py:65)
@@ -55,10 +56,10 @@ class TransformerAgent(nn.Module):
             emb=self.emb, heads=self.heads, depth=self.depth,
             ff_hidden_mult=self.ff_hidden_mult, dropout=self.dropout,
             standard_heads=self.standard_heads,
-            use_orthogonal=self.use_orthogonal,
+            use_orthogonal=self.use_orthogonal, dtype=self.dtype,
             name="transformer")(tokens, tokens, deterministic=deterministic)
 
-        h_new = out[:, 0:1, :]  # token 0 is the new hidden state (:71)
+        h_new = out[:, 0:1, :].astype(jnp.float32)  # token 0 = new hidden (:71)
 
         if self.noisy:
             q = NoisyLinear(self.n_actions, name="q_basic")(
@@ -67,7 +68,10 @@ class TransformerAgent(nn.Module):
             q = nn.Dense(self.n_actions, name="q_basic",
                          kernel_init=orthogonal_or_default(self.use_orthogonal))(h_new)
 
-        return q.reshape(b, a, self.n_actions), h_new.reshape(b, a, self.emb)
+        # Q-values and the carried hidden token stay f32 regardless of the
+        # compute dtype (selector argmax + TD math need full precision)
+        return (q.astype(jnp.float32).reshape(b, a, self.n_actions),
+                h_new.reshape(b, a, self.emb))
 
     def initial_hidden(self, batch_size: int) -> jax.Array:
         """Zeros ``(batch, n_agents, emb)`` (reference ``init_hidden`` zeros

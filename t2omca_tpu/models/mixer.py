@@ -47,6 +47,7 @@ class TransformerMixer(nn.Module):
     state_entity_mode: bool = True
     standard_heads: bool = False
     use_orthogonal: bool = False
+    dtype: jnp.dtype = jnp.float32
 
     def pos_func(self, x: jax.Array) -> jax.Array:
         if self.qmix_pos_func == "softplus":
@@ -72,17 +73,20 @@ class TransformerMixer(nn.Module):
         else:  # Q12: all agents' obs entities
             inputs = obs.reshape(b, self.n_agents * self.n_entities, self.feat_dim)
 
-        embs = nn.Dense(self.emb, name="feat_embedding",
+        embs = nn.Dense(self.emb, name="feat_embedding", dtype=self.dtype,
                         kernel_init=orthogonal_or_default(self.use_orthogonal))(inputs)
 
-        tokens = jnp.concatenate([embs, hidden_states, hyper_weights], axis=1)
+        tokens = jnp.concatenate(
+            [embs, hidden_states.astype(embs.dtype),
+             hyper_weights.astype(embs.dtype)], axis=1)
 
         out = Transformer(
             emb=self.emb, heads=self.heads, depth=self.depth,
             ff_hidden_mult=self.ff_hidden_mult, dropout=self.dropout,
             standard_heads=self.standard_heads,
-            use_orthogonal=self.use_orthogonal,
+            use_orthogonal=self.use_orthogonal, dtype=self.dtype,
             name="transformer")(tokens, tokens, deterministic=deterministic)
+        out = out.astype(jnp.float32)   # hypernet weights + q_tot math in f32
 
         w1 = out[:, -3 - self.n_agents:-3, :]                  # (b, A, emb)
         b1 = out[:, -3, :].reshape(b, 1, self.emb)

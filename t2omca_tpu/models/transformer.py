@@ -52,6 +52,7 @@ class MultiHeadAttention(nn.Module):
     causal: bool = False          # reference ``mask`` ctor flag (upper-tri fill)
     standard_heads: bool = False  # perf mode: per-head dim = emb // heads
     use_orthogonal: bool = False
+    dtype: jnp.dtype = jnp.float32   # compute dtype (bf16 = MXU-native perf mode)
 
     @nn.compact
     def __call__(self, q: jax.Array, k: jax.Array,
@@ -67,7 +68,7 @@ class MultiHeadAttention(nn.Module):
             head_dim = self.emb  # Q1: full-width heads
 
         dense = lambda name: nn.Dense(
-            h * head_dim, use_bias=False, name=name,
+            h * head_dim, use_bias=False, name=name, dtype=self.dtype,
             kernel_init=orthogonal_or_default(self.use_orthogonal))
         keys = dense("tokeys")(k).reshape(b, t_k, h, head_dim)
         queries = dense("toqueries")(q).reshape(b, t_q, h, head_dim)
@@ -93,10 +94,18 @@ class MultiHeadAttention(nn.Module):
             assert mask.ndim == 4, f"mask must be 3D or 4D, got {mask.shape}"
             logits = jnp.where(mask == 0, NEG_MASK_VALUE, logits)
 
-        attn = jax.nn.softmax(logits, axis=-1)
+        # parity mode (f32) keeps f32 softmax; bf16 perf mode stays in bf16
+        # end-to-end — bf16 shares f32's exponent range, so max-subtracted
+        # softmax is range-safe, and skipping the cast avoids materializing
+        # the (b, h, t, t) logits twice
+        if self.dtype == jnp.float32:
+            attn = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        else:
+            attn = jax.nn.softmax(logits, axis=-1)
+        attn = attn.astype(values.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", attn, values)
         out = out.reshape(b, t_q, h * head_dim)
-        return nn.Dense(self.emb, name="unifyheads",
+        return nn.Dense(self.emb, name="unifyheads", dtype=self.dtype,
                         kernel_init=orthogonal_or_default(self.use_orthogonal))(out)
 
 
@@ -110,6 +119,7 @@ class TransformerBlock(nn.Module):
     dropout: float = 0.0
     standard_heads: bool = False
     use_orthogonal: bool = False
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, q: jax.Array, k: jax.Array,
@@ -118,18 +128,20 @@ class TransformerBlock(nn.Module):
         attended = MultiHeadAttention(
             emb=self.emb, heads=self.heads, causal=self.causal,
             standard_heads=self.standard_heads,
-            use_orthogonal=self.use_orthogonal, name="attention")(q, k, mask)
+            use_orthogonal=self.use_orthogonal, dtype=self.dtype,
+            name="attention")(q, k, mask)
 
-        x = nn.LayerNorm(name="norm1")(attended + q)          # post-LN, +query
+        x = nn.LayerNorm(name="norm1", dtype=self.dtype)(attended + q)
         x = nn.Dropout(self.dropout, deterministic=deterministic)(x)
 
         init = orthogonal_or_default(self.use_orthogonal)
         ff = nn.Dense(self.ff_hidden_mult * self.emb, name="ff1",
-                      kernel_init=init)(x)
+                      dtype=self.dtype, kernel_init=init)(x)
         ff = nn.relu(ff)
-        ff = nn.Dense(self.emb, name="ff2", kernel_init=init)(ff)
+        ff = nn.Dense(self.emb, name="ff2", dtype=self.dtype,
+                      kernel_init=init)(ff)
 
-        x = nn.LayerNorm(name="norm2")(ff + x)
+        x = nn.LayerNorm(name="norm2", dtype=self.dtype)(ff + x)
         x = nn.Dropout(self.dropout, deterministic=deterministic)(x)
         return x
 
@@ -148,6 +160,7 @@ class Transformer(nn.Module):
     dropout: float = 0.0
     standard_heads: bool = False
     use_orthogonal: bool = False
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, q: jax.Array, k: jax.Array,
@@ -159,6 +172,6 @@ class Transformer(nn.Module):
                 emb=self.emb, heads=self.heads, causal=False,
                 ff_hidden_mult=self.ff_hidden_mult, dropout=self.dropout,
                 standard_heads=self.standard_heads,
-                use_orthogonal=self.use_orthogonal,
+                use_orthogonal=self.use_orthogonal, dtype=self.dtype,
                 name=f"block_{i}")(x, k, mask, deterministic=deterministic)
         return x

@@ -1,0 +1,3 @@
+from .mesh import (DataParallel, make_mesh, replicate, shard_episode_axis)
+
+__all__ = ["make_mesh", "replicate", "shard_episode_axis", "DataParallel"]

@@ -1,0 +1,129 @@
+"""Data-parallel scaling over a device mesh (SURVEY.md §2.2, §7.2(6)).
+
+The reference has no multi-device story at all — its only "distributed"
+tier is the subprocess env farm over Pipes (``parallel_runner.py:21-32``) and
+a single CUDA device for the learner (``per_run.py:26``). The TPU-native
+replacement (SURVEY.md §2.2 table): a ``jax.sharding.Mesh`` with a ``data``
+axis; env lanes and replay episodes are sharded along it, model/optimizer
+state is replicated, and XLA inserts the gradient ``psum`` over ICI when the
+jitted train step consumes sharded batches — no hand-written collectives, no
+NCCL/MPI equivalent to port.
+
+Axis layout (why DP only): agent/entity token axes are tiny (≤ a few hundred
+entries even at 256 AGVs, SURVEY.md §5.7) and models are ≤ a few M params, so
+TP/PP/SP would ship more bytes over ICI than they save in FLOPs; the scaling
+dimension of this workload is *environments*. The mesh helpers still accept
+extra axes so a ``model`` axis can be added without restructuring
+(extension point noted in SURVEY.md §2.2).
+
+Multi-host: the same code scales to DCN via ``jax.distributed.initialize``
+— ``jax.devices()`` then spans hosts and ``make_mesh`` lays the data axis
+across them; nothing else changes (XLA routes collectives ICI-first).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_names: Sequence[str] = ("data",)) -> Mesh:
+    """1-D (default) mesh over the first ``n_devices`` devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devs)} "
+                f"(hint: XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+        devs = devs[:n_devices]
+    shape = (len(devs),) + (1,) * (len(axis_names) - 1)
+    return Mesh(np.asarray(devs).reshape(shape), axis_names)
+
+
+def replicate(tree, mesh: Mesh):
+    """Fully replicate a pytree across the mesh (params, opt state)."""
+    s = NamedSharding(mesh, P())
+    return jax.device_put(tree, s)
+
+
+def shard_episode_axis(tree, mesh: Mesh, axis: str = "data"):
+    """Shard every leaf's leading (episode/env) axis across ``axis``."""
+    s = NamedSharding(mesh, P(axis))
+    return jax.device_put(tree, s)
+
+
+@dataclasses.dataclass(frozen=True)
+class DataParallel:
+    """Sharded program wrapper for an ``Experiment`` (``run.Experiment``).
+
+    Usage::
+
+        dp = DataParallel(exp, make_mesh(8))
+        ts = dp.shard(exp.init_train_state(seed))
+        rollout, insert, train_iter = dp.jitted_programs()
+
+    The jitted programs are the experiment's own pure functions; sharding
+    comes entirely from the placement of their inputs (GSPMD propagates it),
+    so the single-chip and multi-chip paths are the same code. Requirements:
+    ``batch_size_run`` and ``batch_size`` divisible by the data-axis size.
+    """
+
+    exp: object                  # run.Experiment (duck-typed to avoid cycle)
+    mesh: Mesh
+    axis: str = "data"
+
+    def __post_init__(self):
+        n = self.mesh.shape[self.axis]
+        cfg = self.exp.cfg
+        if (cfg.batch_size_run % n or cfg.batch_size % n
+                or cfg.replay.buffer_size % n):
+            raise ValueError(
+                f"batch_size_run={cfg.batch_size_run}, "
+                f"batch_size={cfg.batch_size} and replay "
+                f"buffer_size={cfg.replay.buffer_size} must all be divisible "
+                f"by the '{self.axis}' axis size {n}")
+
+    # ------------------------------------------------------------------ state
+
+    def shard(self, ts):
+        """Place a TrainState: learner replicated, env lanes and replay
+        episodes sharded over the data axis."""
+        env_sharded = shard_episode_axis(ts.runner.env_states, self.mesh,
+                                         self.axis)
+        runner = ts.runner.replace(
+            env_states=env_sharded,
+            key=replicate(ts.runner.key, self.mesh),
+            t_env=replicate(ts.runner.t_env, self.mesh))
+        storage = shard_episode_axis(ts.buffer.storage, self.mesh, self.axis)
+        buffer = ts.buffer.replace(
+            storage=storage,
+            insert_pos=replicate(ts.buffer.insert_pos, self.mesh),
+            episodes_in_buffer=replicate(ts.buffer.episodes_in_buffer,
+                                         self.mesh),
+            priorities=replicate(ts.buffer.priorities, self.mesh),
+            max_priority=replicate(ts.buffer.max_priority, self.mesh))
+        return ts.replace(
+            learner=replicate(ts.learner, self.mesh),
+            runner=runner,
+            buffer=buffer,
+            episode=replicate(ts.episode, self.mesh),
+        )
+
+    # ------------------------------------------------------------------ programs
+
+    def jitted_programs(self):
+        """The experiment's own three programs with a
+        ``with_sharding_constraint`` injected on every episode batch, so the
+        episode axis stays distributed end-to-end (rollout → insert →
+        sample → train; grads are psum'd by GSPMD since params are
+        replicated and the loss averages over a sharded batch)."""
+        batch_sharding = NamedSharding(self.mesh, P(self.axis))
+        return self.exp.jitted_programs(
+            constrain_batch=lambda b: jax.lax.with_sharding_constraint(
+                b, batch_sharding))

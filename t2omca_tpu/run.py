@@ -80,6 +80,7 @@ class Experiment:
             n_actions=env_info["n_actions"],
             obs_dim=env_info["obs_shape"],
             state_dim=env_info["state_shape"],
+            store_dtype=cfg.replay.store_dtype,
         )
         if cfg.replay.prioritized:
             buf_kw.update(alpha=cfg.replay.per_alpha,
@@ -102,11 +103,23 @@ class Experiment:
 
     # ------------------------------------------------------------------ programs
 
-    def jitted_programs(self):
+    def jitted_programs(self, constrain_batch=None):
+        """→ (rollout, insert, train_iter) jitted programs.
+
+        ``constrain_batch`` is an optional ``EpisodeBatch → EpisodeBatch``
+        hook applied to rollout outputs and training samples — the
+        multi-chip path (``parallel.DataParallel``) injects a
+        ``with_sharding_constraint`` through it so both paths share one
+        train-iteration definition."""
         runner, buffer, learner, cfg = (self.runner, self.buffer,
                                         self.learner, self.cfg)
+        constrain = constrain_batch or (lambda b: b)
 
-        rollout = jax.jit(runner.run, static_argnames="test_mode")
+        def _rollout(params, rs, test_mode):
+            rs2, batch, stats = runner.run(params, rs, test_mode=test_mode)
+            return rs2, constrain(batch), stats
+
+        rollout = jax.jit(_rollout, static_argnames="test_mode")
         insert = jax.jit(buffer.insert_episode_batch)
 
         def _train_iter(ts: TrainState, key: jax.Array, t_env: jnp.ndarray):
@@ -114,7 +127,7 @@ class Experiment:
             batch, idx, weights = buffer.sample(
                 ts.buffer, key, cfg.batch_size, t_env)
             learner_state, info = learner.train(
-                ts.learner, batch, weights, t_env, ts.episode)
+                ts.learner, constrain(batch), weights, t_env, ts.episode)
             buf = buffer.update_priorities(
                 ts.buffer, idx, info["td_errors_abs"] + 1e-6)   # Q9
             return ts.replace(learner=learner_state, buffer=buf), info

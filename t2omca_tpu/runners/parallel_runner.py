@@ -118,8 +118,11 @@ class ParallelRunner:
             actions, hidden, eps = self.mac.select_actions(
                 params, obs, avail, hidden, k_act, t_env,
                 test_mode=test_mode)
-            # Q15: the action is recorded with the pre-step observation
-            pre = (obs, gstate, avail, actions)
+            # Q15: the action is recorded with the pre-step observation.
+            # Cast to the storage dtype here so the scan stacks the compact
+            # representation (the f32 episode stack is the HBM hot spot).
+            sd = jnp.dtype(self.cfg.replay.store_dtype)
+            pre = (obs.astype(sd), gstate.astype(sd), avail, actions)
             viz = ((env_states.pos, env_states.mec_index)
                    if capture else None)
             env_states, reward, terminated, info, obs, gstate, avail = \
@@ -142,9 +145,10 @@ class ParallelRunner:
         cat_last = lambda seq, last: jnp.concatenate(
             [bt(seq), last[:, None]], axis=1)
 
+        sd = jnp.dtype(self.cfg.replay.store_dtype)
         batch = EpisodeBatch(
-            obs=cat_last(obs_seq, last_obs),
-            state=cat_last(gstate_seq, last_gstate),
+            obs=cat_last(obs_seq, last_obs.astype(sd)),
+            state=cat_last(gstate_seq, last_gstate.astype(sd)),
             avail_actions=cat_last(avail_seq, last_avail),
             actions=bt(action_seq),
             reward=bt(reward),

@@ -1,20 +1,16 @@
 """Test configuration: force the JAX CPU backend with 8 virtual devices.
 
-SURVEY.md §4: multi-chip paths are tested without a cluster via
-``xla_force_host_platform_device_count``. The axon sitecustomize registers a
-TPU backend whenever ``PALLAS_AXON_POOL_IPS`` is set, so it is cleared before
-anything imports jax.
+SURVEY.md §4: multi-chip paths are tested without a cluster, on a faked
+8-device CPU mesh. Environment traps: the axon sitecustomize registers a TPU
+backend at interpreter start, and ``import pytest`` itself imports jax
+(plugin entry points), so env-var mutation here is too late. The jax config
+API works post-import because backends initialize lazily:
+``jax_platforms='cpu'`` overrides the axon selection and
+``jax_num_cpu_devices=8`` replaces ``xla_force_host_platform_device_count``.
 """
 
-import os
+import jax
 
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_default_matmul_precision", "highest")
