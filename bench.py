@@ -30,6 +30,9 @@ def main() -> int:
     ap.add_argument("--envs", type=int, default=None)
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--no-pallas", action="store_true",
+                    help="XLA acting path (reproduces the BASELINE.md "
+                         "XLA-path row)")
     args = ap.parse_args()
 
     if args.smoke:
@@ -68,7 +71,8 @@ def main() -> int:
                                episode_limit=steps),
             model=ModelConfig(emb=256, heads=4, depth=2, mixer_emb=256,
                               mixer_heads=4, mixer_depth=2,
-                              standard_heads=True, dtype="bfloat16"),
+                              standard_heads=True, dtype="bfloat16",
+                              use_pallas=not args.no_pallas),
             replay=ReplayConfig(buffer_size=4, store_dtype="bfloat16"),
         ))
 

@@ -66,6 +66,7 @@ class ModelConfig:
     use_orthogonal: bool = False
     standard_heads: bool = False          # perf mode: per-head dim = emb//heads (quirk Q1 off)
     dtype: str = "float32"                # compute dtype: float32 | bfloat16 (perf mode)
+    use_pallas: bool = False              # fused-kernel acting path (rollout forwards)
     # entity counts: filled from env info when 0
     n_entities_obs: int = 0
     n_entities_state: int = 0
@@ -159,6 +160,11 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
                 f"emb={cfg.model.emb}/heads={cfg.model.heads}, "
                 f"mixer_emb={cfg.model.mixer_emb}/mixer_heads={cfg.model.mixer_heads}."
             )
+    if cfg.model.use_pallas and (cfg.model.dropout != 0.0
+                                 or cfg.action_selector == "noisy-new"):
+        raise ValueError(
+            "use_pallas supports only dropout=0 and non-noisy agents "
+            "(the fused acting kernel has no dropout/noise path)")
     if cfg.model.mixer_emb != cfg.model.emb:
         raise ValueError(
             "mixer_emb must equal emb: the mixer concatenates agent hidden "

@@ -34,6 +34,8 @@ class BasicMAC:
     n_agents: int
     n_actions: int
     emb: int
+    use_pallas: bool = False    # fused-kernel acting path (ops/fast_agent)
+    pallas_interpret: bool = False
 
     @classmethod
     def build(cls, cfg: TrainConfig, env_info: dict) -> "BasicMAC":
@@ -43,6 +45,18 @@ class BasicMAC:
         if feat is None:
             # flat-obs mode: the whole obs vector is one entity token
             n_entities, feat = 1, env_info["obs_shape"]
+        use_pallas = cfg.model.use_pallas
+        if use_pallas:
+            if cfg.model.dropout != 0.0 or cfg.action_selector == "noisy-new":
+                # also enforced in config.sanity_check; kept for callers
+                # that build a MAC without going through load_config
+                raise ValueError(
+                    "use_pallas supports only dropout=0 and non-noisy agents")
+            backend = jax.default_backend()
+            if backend not in ("tpu", "cpu"):
+                raise ValueError(
+                    f"use_pallas requires a TPU (or CPU-interpret) backend; "
+                    f"got '{backend}' — unset model.use_pallas")
         agent = TransformerAgent(
             n_agents=n_agents,
             n_entities=n_entities + 0,
@@ -62,7 +76,9 @@ class BasicMAC:
             cfg.epsilon_start, cfg.epsilon_finish, cfg.epsilon_anneal_time)
         selector = SELECTOR_REGISTRY[cfg.action_selector](schedule)
         return cls(agent=agent, selector=selector, n_agents=n_agents,
-                   n_actions=env_info["n_actions"], emb=cfg.model.emb)
+                   n_actions=env_info["n_actions"], emb=cfg.model.emb,
+                   use_pallas=use_pallas,
+                   pallas_interpret=jax.default_backend() == "cpu")
 
     # ------------------------------------------------------------------ state
 
@@ -91,6 +107,19 @@ class BasicMAC:
         return self.agent.apply(params, obs, hidden,
                                 deterministic=deterministic, rngs=rngs)
 
+    def forward_fast(self, params, obs: jnp.ndarray, hidden: jnp.ndarray
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Fused-kernel forward over the same param tree (acting path; no
+        gradient support — the learner differentiates ``forward``)."""
+        from ..ops.fast_agent import agent_forward_fast
+        a = self.agent
+        return agent_forward_fast(
+            params, obs, hidden,
+            n_entities=a.n_entities, feat_dim=a.feat_dim, emb=a.emb,
+            heads=a.heads, depth=a.depth, n_actions=a.n_actions,
+            standard_heads=a.standard_heads, dtype=a.dtype,
+            interpret=self.pallas_interpret)
+
     def select_actions(self, params, obs: jnp.ndarray, avail: jnp.ndarray,
                        hidden: jnp.ndarray, key: jax.Array,
                        t_env: jnp.ndarray, test_mode: bool = False
@@ -98,8 +127,11 @@ class BasicMAC:
         """→ (actions ``(B, A)`` int32, hidden', epsilon). The avail mask is
         applied inside the selector (illegal-action masking, M7)."""
         k_noise, k_sel = jax.random.split(key)
-        q, hidden = self.forward(params, obs, hidden, key=k_noise,
-                                 deterministic=test_mode)
+        if self.use_pallas:
+            q, hidden = self.forward_fast(params, obs, hidden)
+        else:
+            q, hidden = self.forward(params, obs, hidden, key=k_noise,
+                                     deterministic=test_mode)
         actions, eps = self.selector.select(k_sel, q, avail, t_env,
                                             test_mode=test_mode)
         return actions.astype(jnp.int32), hidden, eps

@@ -1,0 +1,3 @@
+from .transformer_block import fused_transformer_block
+
+__all__ = ["fused_transformer_block"]

@@ -1,0 +1,90 @@
+"""Fast (fused-kernel) agent forward over the standard flax param tree.
+
+The acting path — ``episode_limit`` sequential agent forwards inside the
+rollout scan — is HBM-bandwidth bound under XLA (BASELINE.md). This module
+re-implements ``TransformerAgent.__call__`` as a pure function that reads
+the SAME parameter pytree the flax module owns and dispatches every
+transformer block to ``fused_transformer_block`` (one VMEM-resident Pallas
+kernel per block). No separate parameters, no checkpoint divergence: the
+learner keeps differentiating the flax module; the rollout calls this.
+
+Semantics mirror ``models/agent.py`` + ``models/transformer.py`` exactly:
+entity embedding, hidden token prepended at position 0, layer-0 key
+threading across depth (keys pinned to the embedded input tokens), token 0
+out as (new hidden, Q-head input). Dropout must be 0 (it is in every
+reference config; guarded at build time in the MAC).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .transformer_block import fused_transformer_block
+
+
+def fast_transformer_apply(tf_params: dict, tokens: jnp.ndarray,
+                           heads: int, depth: int, head_dim: int,
+                           interpret: bool = False) -> jnp.ndarray:
+    """Apply ``depth`` fused blocks; ``tokens (S, T, E)``. Keys stay pinned
+    to the layer-0 input (``transformer.py:126,140`` tuple threading).
+    The token axis is padded to a sublane multiple ONCE here so every
+    layer's kernel works on layout-trivial shapes; the caller slices."""
+    t = tokens.shape[1]
+    sublane = 16 if tokens.dtype == jnp.bfloat16 else 8
+    tp = -(-t // sublane) * sublane
+    if tp != t:
+        tokens = jnp.pad(tokens, [(0, 0), (0, tp - t), (0, 0)])
+    k0 = tokens
+    x = tokens
+    for i in range(depth):
+        bp = tf_params[f"block_{i}"]
+        at = bp["attention"]
+        x = fused_transformer_block(
+            x, k0,
+            at["toqueries"]["kernel"], at["tokeys"]["kernel"],
+            at["tovalues"]["kernel"],
+            at["unifyheads"]["kernel"], at["unifyheads"]["bias"],
+            bp["norm1"]["scale"], bp["norm1"]["bias"],
+            bp["ff1"]["kernel"], bp["ff1"]["bias"],
+            bp["ff2"]["kernel"], bp["ff2"]["bias"],
+            bp["norm2"]["scale"], bp["norm2"]["bias"],
+            heads=heads, head_dim=head_dim, interpret=interpret,
+            t_real=t)
+    return x[:, :t, :] if tp != t else x
+
+
+def agent_forward_fast(variables: dict, inputs: jnp.ndarray,
+                       hidden_state: jnp.ndarray, *,
+                       n_entities: int, feat_dim: int, emb: int,
+                       heads: int, depth: int, n_actions: int,
+                       standard_heads: bool = False,
+                       dtype=jnp.float32,
+                       interpret: bool = False
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Drop-in for ``TransformerAgent.apply`` (non-noisy, dropout=0):
+    inputs ``(B, A, obs)``, hidden ``(B, A, emb)`` → (q, hidden')."""
+    p = variables["params"]
+    b, a, _ = inputs.shape
+    x = inputs.reshape(b * a, n_entities, feat_dim).astype(dtype)
+    h = hidden_state.reshape(b * a, 1, emb).astype(dtype)
+
+    fe = p["feat_embedding"]
+    embs = (jnp.dot(x, fe["kernel"].astype(dtype),
+                    preferred_element_type=jnp.float32)
+            + fe["bias"].astype(jnp.float32)).astype(dtype)
+
+    tokens = jnp.concatenate([h, embs], axis=1)
+    head_dim = emb // heads if standard_heads else emb
+    out = fast_transformer_apply(p["transformer"], tokens, heads, depth,
+                                 head_dim, interpret=interpret)
+
+    h_new = out[:, 0, :].astype(jnp.float32)
+    qb = p["q_basic"]
+    q = (jnp.dot(h_new, qb["kernel"].astype(jnp.float32),
+                 preferred_element_type=jnp.float32)
+         + qb["bias"].astype(jnp.float32))
+    return (q.reshape(b, a, n_actions),
+            h_new.reshape(b, a, emb))

@@ -1,0 +1,182 @@
+"""Fused transformer block as a Pallas TPU kernel.
+
+Why: profiling (BASELINE.md, round-1 measurements) shows the acting-path
+transformer is **HBM-bandwidth bound**, not MXU bound — the XLA path
+materializes QKV, per-head transposes, attention logits, the 4×emb FFN
+hidden, and every residual/LN intermediate to HBM, ~40+ passes over ~1 GB
+activations per forward at the north-star scale. This kernel computes the
+ENTIRE block (QKV → per-head attention → output proj → post-LN → FFN →
+post-LN) for a tile of sequences without leaving VMEM: HBM traffic drops to
+one read of the query/key blocks + one write of the output block + the
+(tiny, reused) weights.
+
+Semantics: bit-compatible layout with ``models.transformer.TransformerBlock``
+(same param tree; quirks Q1/Q2 and the layer-0 key threading are honored by
+the caller passing ``x_k`` = the layer-0 key embeddings to every depth).
+Attention softmax and LN statistics are computed in f32; matmuls accumulate
+in f32 with bf16 operands (MXU-native).
+
+Scope: forward only (no custom VJP) — used on the acting/rollout path and
+target-network unrolls where no gradient flows. The learner's differentiable
+unroll uses the XLA path with identical parameters.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LN_EPS = 1e-6   # must match models.transformer._layer_norm
+
+
+def _pick_tile(s: int, target: int = 16) -> int:
+    """Largest divisor of ``s`` that is ≤ target (grid must tile exactly)."""
+    for g in range(min(target, s), 0, -1):
+        if s % g == 0:
+            return g
+    return 1
+
+
+def _ln(x32: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray
+        ) -> jnp.ndarray:
+    """f32 fast-variance LayerNorm over the last axis (flax-compatible)."""
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.maximum(
+        jnp.mean(x32 * x32, axis=-1, keepdims=True) - mean * mean, 0.0)
+    inv = jax.lax.rsqrt(var + LN_EPS)
+    return (x32 - mean) * inv * scale + bias
+
+
+def _block_kernel(xq_ref, xk_ref, wq_ref, wk_ref, wv_ref, wo_ref, wob_ref,
+                  n1s_ref, n1b_ref, w1_ref, b1_ref, w2_ref, b2_ref,
+                  n2s_ref, n2b_ref, out_ref, *, heads: int, head_dim: int,
+                  t_real: int):
+    g, t, e = xq_ref.shape   # t is padded to a sublane multiple
+    d = head_dim
+    cdt = xq_ref.dtype   # compute dtype of the activations (bf16 or f32)
+
+    xq = xq_ref[:].reshape(g * t, e)
+    xk = xk_ref[:].reshape(g * t, e)
+
+    # padded key positions (j >= t_real) are masked out of every softmax
+    key_pad = (jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+               >= t_real)[None]              # (1, t, t), broadcasts over g
+
+    # Per-head attention with weights pre-split (H, E, D) so the kernel only
+    # ever indexes leading dims — Mosaic supports neither multi-batch-dim
+    # matmuls nor lane-splitting reshapes. The head loop is unrolled
+    # (heads is static and small); attention FLOPs are a minor term.
+    scale = d ** -0.25
+    attended = wob_ref[:].astype(jnp.float32)        # (1, E), broadcasts
+    for hi in range(heads):
+        q = jnp.dot(xq, wq_ref[hi], preferred_element_type=jnp.float32)
+        k = jnp.dot(xk, wk_ref[hi], preferred_element_type=jnp.float32)
+        v = jnp.dot(xk, wv_ref[hi], preferred_element_type=jnp.float32)
+        # Q1 scaling: queries AND keys divided by head_dim ** 1/4
+        q = (q * scale).astype(cdt).reshape(g, t, d)
+        k = (k * scale).astype(cdt).reshape(g, t, d)
+        v = v.astype(cdt).reshape(g, t, d)
+        logits = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)      # (g, t, t)
+        logits = jnp.where(key_pad, -1e30, logits)
+        attn = jax.nn.softmax(logits, axis=-1).astype(cdt)
+        ctx = jax.lax.dot_general(
+            attn, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)      # (g, t, d)
+        ctx = ctx.astype(cdt).reshape(g * t, d)
+        attended = attended + jnp.dot(
+            ctx, wo_ref[hi], preferred_element_type=jnp.float32)
+
+    # Q2: post-LN over (attended + query input), f32 statistics
+    x1 = _ln(attended + xq.astype(jnp.float32), n1s_ref[:], n1b_ref[:])
+
+    # FFN fused: the (g*t, 4e) hidden never leaves VMEM
+    hcast = x1.astype(cdt)
+    hid = jnp.dot(hcast, w1_ref[:], preferred_element_type=jnp.float32)
+    hid = jnp.maximum(hid + b1_ref[:].astype(jnp.float32), 0.0).astype(cdt)
+    y = jnp.dot(hid, w2_ref[:], preferred_element_type=jnp.float32)
+    y = y + b2_ref[:].astype(jnp.float32)
+
+    x2 = _ln(y + x1, n2s_ref[:], n2b_ref[:])
+    out_ref[:] = x2.astype(cdt).reshape(g, t, e)
+
+
+def fused_transformer_block(
+        x_q: jnp.ndarray, x_k: jnp.ndarray,
+        wq: jnp.ndarray, wk: jnp.ndarray, wv: jnp.ndarray,
+        wo: jnp.ndarray, wo_b: jnp.ndarray,
+        n1_scale: jnp.ndarray, n1_bias: jnp.ndarray,
+        w1: jnp.ndarray, b1: jnp.ndarray,
+        w2: jnp.ndarray, b2: jnp.ndarray,
+        n2_scale: jnp.ndarray, n2_bias: jnp.ndarray,
+        heads: int, head_dim: int,
+        interpret: bool = False, t_real: int | None = None) -> jnp.ndarray:
+    """One transformer block over ``(S, T, E)`` sequences, fully fused.
+
+    ``x_q``/``x_k`` are the query tokens and the (layer-0) key tokens.
+    Weight layouts match the flax modules: ``wq/wk/wv (E, H·D)``,
+    ``wo (H·D, E)``, ``w1 (E, ff·E)``, ``w2 (ff·E, E)``.
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU tests).
+    ``t_real``: pass the true token count when the input is already padded
+    to a sublane multiple (multi-layer callers pad once); the output then
+    stays padded.
+    """
+    s, t, e = x_q.shape
+    pre_padded = t_real is not None
+    if t_real is None:
+        t_real = t
+    g = _pick_tile(s)
+    cdt = x_q.dtype
+    # pad the token axis to a sublane multiple: in-kernel (g, t, e) →
+    # (g·t, e) reshapes are layout-trivial only when t is tile-aligned
+    # (Mosaic rejects merges of padded sublane dims as 'unsupported shape
+    # cast'); padded keys are softmax-masked inside the kernel
+    sublane = 16 if cdt == jnp.bfloat16 else 8
+    tp = -(-t // sublane) * sublane
+    if tp != t:
+        pad = [(0, 0), (0, tp - t), (0, 0)]
+        x_q = jnp.pad(x_q, pad)
+        x_k = jnp.pad(x_k, pad)
+    wcast = lambda w: w.astype(cdt)
+    # 1-D params become (1, n): TPU VMEM wants ≥2-D operands
+    row = lambda v, dt=jnp.float32: v.astype(dt).reshape(1, -1)
+    # pre-split heads OUTSIDE the kernel (XLA handles the relayout once):
+    # (E, H·D) → (H, E, D) for q/k/v, (H·D, E) → (H, D, E) for the out proj
+    split_in = lambda w: (w.reshape(e, heads, head_dim)
+                          .transpose(1, 0, 2).astype(cdt))
+    wq, wk, wv = split_in(wq), split_in(wk), split_in(wv)
+    wo = wo.reshape(heads, head_dim, e).astype(cdt)
+
+    kernel = functools.partial(_block_kernel, heads=heads,
+                               head_dim=head_dim, t_real=t_real)
+    seq_spec = pl.BlockSpec((g, tp, e), lambda i: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+    full = lambda shape: pl.BlockSpec(
+        shape, lambda i: (0,) * len(shape), memory_space=pltpu.VMEM)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(s // g,),
+        in_specs=[
+            seq_spec, seq_spec,
+            full(wq.shape), full(wk.shape), full(wv.shape),
+            full(wo.shape), full((1, wo_b.shape[-1])),
+            full((1, n1_scale.shape[-1])), full((1, n1_bias.shape[-1])),
+            full(w1.shape), full((1, b1.shape[-1])),
+            full(w2.shape), full((1, b2.shape[-1])),
+            full((1, n2_scale.shape[-1])), full((1, n2_bias.shape[-1])),
+        ],
+        out_specs=seq_spec,
+        out_shape=jax.ShapeDtypeStruct((s, tp, e), cdt),
+        interpret=interpret,
+    )(x_q, x_k, wq, wk, wv, wo, row(wo_b),
+      row(n1_scale), row(n1_bias),
+      wcast(w1), row(b1), wcast(w2), row(b2),
+      row(n2_scale), row(n2_bias))
+    return out if pre_padded else (out[:, :t, :] if tp != t else out)

@@ -1,0 +1,135 @@
+"""Fused Pallas transformer-block kernel vs the flax reference path.
+
+Runs in the Pallas interpreter on CPU (SURVEY.md §4: no-cluster testing);
+the same kernel compiles via Mosaic on TPU (exercised by bench.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from t2omca_tpu.config import (EnvConfig, ModelConfig, TrainConfig,
+                               sanity_check)
+from t2omca_tpu.controllers import BasicMAC
+from t2omca_tpu.envs.registry import make_env
+from t2omca_tpu.models.transformer import TransformerBlock
+from t2omca_tpu.ops.transformer_block import fused_transformer_block
+
+
+def _block_params(key, emb, heads, standard_heads=True):
+    blk = TransformerBlock(emb=emb, heads=heads,
+                           standard_heads=standard_heads)
+    x = jnp.zeros((2, 5, emb))
+    return blk, blk.init(key, x, x)["params"]
+
+
+def _run_fused(params, xq, xk, heads, head_dim):
+    at = params["attention"]
+    return fused_transformer_block(
+        xq, xk,
+        at["toqueries"]["kernel"], at["tokeys"]["kernel"],
+        at["tovalues"]["kernel"],
+        at["unifyheads"]["kernel"], at["unifyheads"]["bias"],
+        params["norm1"]["scale"], params["norm1"]["bias"],
+        params["ff1"]["kernel"], params["ff1"]["bias"],
+        params["ff2"]["kernel"], params["ff2"]["bias"],
+        params["norm2"]["scale"], params["norm2"]["bias"],
+        heads=heads, head_dim=head_dim, interpret=True)
+
+
+@pytest.mark.parametrize("t", [5, 8, 16, 17])
+def test_fused_block_matches_flax_f32(t):
+    """Arbitrary (non-aligned) token counts: padding+masking must be exact."""
+    emb, heads, s = 16, 2, 6
+    blk, params = _block_params(jax.random.PRNGKey(0), emb, heads)
+    xq = jax.random.normal(jax.random.PRNGKey(1), (s, t, emb))
+    xk = jax.random.normal(jax.random.PRNGKey(2), (s, t, emb))
+    ref = blk.apply({"params": params}, xq, xk)
+    fused = _run_fused(params, xq, xk, heads, emb // heads)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_fused_block_full_emb_heads():
+    """Quirk Q1 geometry (per-head dim = emb) through the kernel."""
+    emb, heads, s, t = 8, 3, 4, 5
+    blk, params = _block_params(jax.random.PRNGKey(3), emb, heads,
+                                standard_heads=False)
+    xq = jax.random.normal(jax.random.PRNGKey(4), (s, t, emb))
+    xk = jax.random.normal(jax.random.PRNGKey(5), (s, t, emb))
+    ref = blk.apply({"params": params}, xq, xk)
+    fused = _run_fused(params, xq, xk, heads, emb)   # head_dim = emb
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("dtype,std,tol", [("float32", False, 1e-5),
+                                           ("float32", True, 1e-5),
+                                           ("bfloat16", True, 0.05)])
+def test_fast_agent_matches_module(dtype, std, tol):
+    """forward_fast (fused acting path) ≈ flax forward on the same params,
+    including the depth-2 layer-0 key threading and hidden-token recurrence."""
+    cfg = sanity_check(TrainConfig(
+        env_args=EnvConfig(agv_num=4, mec_num=2, num_channels=2,
+                           episode_limit=4),
+        model=ModelConfig(emb=8, heads=2, depth=2, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1,
+                          standard_heads=std, dtype=dtype,
+                          use_pallas=True)))
+    env = make_env(cfg.env_args)
+    info = env.get_env_info()
+    mac = BasicMAC.build(cfg, info)
+    params = mac.init_params(jax.random.PRNGKey(0), info["obs_shape"])
+    obs = jax.random.normal(jax.random.PRNGKey(1),
+                            (3, 4, info["obs_shape"]))
+    h = jax.random.normal(jax.random.PRNGKey(2), (3, 4, 8))
+    q_ref, h_ref = mac.forward(params, obs, h)
+    q_fast, h_fast = mac.forward_fast(params, obs, h)
+    np.testing.assert_allclose(np.asarray(q_fast), np.asarray(q_ref),
+                               atol=tol, rtol=tol)
+    np.testing.assert_allclose(np.asarray(h_fast), np.asarray(h_ref),
+                               atol=tol, rtol=tol)
+
+
+def test_pallas_rollout_matches_shapes_and_legality():
+    """Full rollout with the fused acting path (interpret mode on CPU)."""
+    from t2omca_tpu.runners import ParallelRunner
+    from t2omca_tpu.learners import QMixLearner
+
+    cfg = sanity_check(TrainConfig(
+        batch_size_run=2,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=3),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1,
+                          standard_heads=True, dtype="bfloat16",
+                          use_pallas=True)))
+    env = make_env(cfg.env_args)
+    info = env.get_env_info()
+    mac = BasicMAC.build(cfg, info)
+    learner = QMixLearner.build(cfg, mac, info)
+    runner = ParallelRunner(env, mac, cfg)
+    ls = learner.init_state(jax.random.PRNGKey(0))
+    rs = runner.init_state(jax.random.PRNGKey(1))
+    rs, batch, stats = jax.jit(runner.run, static_argnames="test_mode")(
+        ls.params["agent"], rs, test_mode=False)
+    avail = np.asarray(batch.avail_actions[:, :-1])
+    actions = np.asarray(batch.actions)
+    taken = np.take_along_axis(avail, actions[..., None], axis=-1)
+    assert (taken == 1).all()
+    assert np.isfinite(np.asarray(stats.episode_return)).all()
+
+
+def test_use_pallas_rejects_noisy_and_dropout():
+    cfg = TrainConfig(
+        env_args=EnvConfig(agv_num=3, mec_num=2, episode_limit=4),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1, use_pallas=True,
+                          dropout=0.1))
+    with pytest.raises(ValueError, match="use_pallas"):
+        sanity_check(cfg)
+    # the MAC-level guard also fires for callers bypassing sanity_check
+    env = make_env(cfg.env_args)
+    with pytest.raises(ValueError, match="use_pallas"):
+        BasicMAC.build(cfg, env.get_env_info())
