@@ -1,0 +1,193 @@
+"""Host-RAM prioritized replay with a native sum-tree index.
+
+The reference's ``buffer_cpu_only`` flag keeps replay on CPU and moves only
+sampled batches to the accelerator (``/root/reference/per_run.py:143-146``,
+``:229-230``). This is that mode for the TPU framework: episode storage in
+pinned host NumPy (capacity bounded by RAM, not HBM), priorities in the
+C++ sum-tree (``native/sumtree.cpp``, O(log n) set/sample via ctypes), and a
+pure-NumPy ``PySumTree`` fallback when no g++ toolchain exists.
+
+Same method surface as the device buffers (insert / can_sample / sample /
+update_priorities) so the driver only branches on ``is_host`` to skip
+jitting the buffer stages. Sampling semantics match the device PER:
+stratified inverse-CDF over ``p^alpha``, importance weights ``(N·P)^-beta``
+max-normalized, beta annealed to 1 over ``t_max`` (Q9 priorities flow back
+per sampled episode).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from .episode_buffer import EpisodeBatch
+
+
+class PySumTree:
+    """NumPy fallback with the same operations as the native tree."""
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self.leaf = np.zeros(cap, np.float64)
+
+    def set_batch(self, idx, pri):
+        self.leaf[idx] = pri
+
+    def get(self, idx):
+        return self.leaf[idx]
+
+    def total(self):
+        return float(self.leaf.sum())
+
+    def sample(self, us: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        n = len(us)
+        cdf = np.cumsum(self.leaf)
+        u = (np.arange(n) + us) / n * cdf[-1]
+        idx = np.minimum(np.searchsorted(cdf, u, side="right"),
+                         self.cap - 1)
+        return idx.astype(np.int64), self.leaf[idx]
+
+
+class NativeSumTree:
+    """ctypes wrapper over native/sumtree.cpp (extern "C" ABI)."""
+
+    def __init__(self, cap: int):
+        from ..native import load_sumtree
+        self._lib = load_sumtree()
+        self.cap = 1
+        while self.cap < cap:
+            self.cap *= 2
+        self._ptr = self._lib.sumtree_create(self.cap)
+        if not self._ptr:
+            raise MemoryError("sumtree_create failed")
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        ptr = getattr(self, "_ptr", None)
+        if lib is not None and ptr:
+            lib.sumtree_free(ptr)
+
+    def set_batch(self, idx, pri):
+        idx = np.ascontiguousarray(idx, np.int64)
+        pri = np.ascontiguousarray(pri, np.float64)
+        self._lib.sumtree_set_batch(
+            self._ptr, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            pri.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), len(idx))
+
+    def get(self, idx):
+        idx = np.atleast_1d(idx)
+        return np.array([self._lib.sumtree_get(self._ptr, int(i))
+                         for i in idx])
+
+    def total(self):
+        return self._lib.sumtree_total(self._ptr)
+
+    def sample(self, us: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        n = len(us)
+        us = np.ascontiguousarray(us, np.float64)
+        out_idx = np.empty(n, np.int64)
+        out_pri = np.empty(n, np.float64)
+        self._lib.sumtree_sample(
+            self._ptr, us.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            n, out_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            out_pri.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        return out_idx, out_pri
+
+
+def _make_tree(cap: int):
+    try:
+        return NativeSumTree(cap)
+    except Exception:
+        return PySumTree(cap)
+
+
+@dataclasses.dataclass
+class HostReplayBuffer:
+    """Prioritized episode replay in host RAM (reference buffer_cpu_only)."""
+
+    capacity: int
+    episode_limit: int
+    n_agents: int
+    n_actions: int
+    obs_dim: int
+    state_dim: int
+    alpha: float = 0.6
+    beta0: float = 0.4
+    t_max: int = 1
+    store_dtype: str = "float32"
+    prioritized: bool = True
+    is_host: bool = True
+
+    def __post_init__(self):
+        t, cap = self.episode_limit, self.capacity
+        if self.store_dtype == "bfloat16":
+            import ml_dtypes  # ships with jax
+            sd = np.dtype(ml_dtypes.bfloat16)
+        else:
+            sd = np.dtype(self.store_dtype)
+        self._storage = EpisodeBatch(
+            obs=np.zeros((cap, t + 1, self.n_agents, self.obs_dim), sd),
+            state=np.zeros((cap, t + 1, self.state_dim), sd),
+            avail_actions=np.zeros((cap, t + 1, self.n_agents,
+                                    self.n_actions), np.int32),
+            actions=np.zeros((cap, t, self.n_agents), np.int32),
+            reward=np.zeros((cap, t), np.float32),
+            terminated=np.zeros((cap, t), bool),
+            filled=np.zeros((cap, t), bool),
+        )
+        self._tree = _make_tree(cap)
+        self._pos = 0
+        self._count = 0
+        self._max_priority = 1.0
+        self._rng = np.random.default_rng(0)
+
+    # ------------------------------------------------------------- protocol
+
+    def insert_episode_batch(self, batch: EpisodeBatch) -> None:
+        host = jax.device_get(batch)
+        b = host.obs.shape[0]
+        idx = (self._pos + np.arange(b)) % self.capacity
+        for name in ("obs", "state", "avail_actions", "actions", "reward",
+                     "terminated", "filled"):
+            getattr(self._storage, name)[idx] = np.asarray(
+                getattr(host, name), getattr(self._storage, name).dtype)
+        if self.prioritized:
+            self._tree.set_batch(idx, np.full(
+                b, self._max_priority ** self.alpha))
+        self._pos = int((self._pos + b) % self.capacity)
+        self._count = int(min(self._count + b, self.capacity))
+
+    def can_sample(self, batch_size: int) -> bool:
+        return self._count >= batch_size
+
+    def sample(self, batch_size: int, t_env: int
+               ) -> Tuple[EpisodeBatch, np.ndarray, np.ndarray]:
+        n = self._count
+        if self.prioritized:
+            us = self._rng.random(batch_size)
+            idx, pri_a = self._tree.sample(us)
+            idx = np.minimum(idx, n - 1)
+            total = self._tree.total()
+            probs = pri_a / max(total, 1e-12)
+            beta = self.beta0 + (1.0 - self.beta0) * min(
+                max(float(t_env) / self.t_max, 0.0), 1.0)
+            w = (n * np.maximum(probs, 1e-12)) ** (-beta)
+            w = (w / max(w.max(), 1e-12)).astype(np.float32)
+        else:
+            idx = self._rng.choice(n, size=batch_size, replace=False)
+            w = np.ones(batch_size, np.float32)
+        batch = jax.tree.map(lambda s: jax.numpy.asarray(s[idx]),
+                             self._storage)
+        return batch, idx, jax.numpy.asarray(w)
+
+    def update_priorities(self, idx: np.ndarray,
+                          priorities: np.ndarray) -> None:
+        if not self.prioritized:
+            return
+        pri = np.asarray(jax.device_get(priorities), np.float64)
+        self._max_priority = float(max(self._max_priority, pri.max()))
+        self._tree.set_batch(np.asarray(idx, np.int64), pri ** self.alpha)

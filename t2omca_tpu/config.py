@@ -113,6 +113,11 @@ class TrainConfig:
     animation_interval: int = 200_000
     animation_interval_evaluation: int = 0
 
+    # tracing/profiling (capability upgrade over the reference, SURVEY.md §5(1))
+    profile_dir: str = ""                 # jax.profiler trace output ("" = off)
+    profile_start: int = 0                # t_env at which to start the trace
+    profile_iterations: int = 3           # driver iterations to capture
+
     # component selection (registries, reference §5.6)
     runner: str = "parallel"
     mac: str = "basic_mac"

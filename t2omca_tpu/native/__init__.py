@@ -1,0 +1,3 @@
+from .build import load_sumtree
+
+__all__ = ["load_sumtree"]

@@ -1,0 +1,64 @@
+"""Build + load the native sum-tree via ctypes.
+
+No pybind11 in the image (environment constraint), so the C++ side is a
+plain ``extern "C"`` shared object compiled with g++ on first use and cached
+next to the source keyed by source mtime. Callers should catch
+``NativeBuildError`` and fall back to the pure-NumPy sum-tree
+(``components/host_replay.PySumTree``) when no toolchain is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+_SRC = os.path.join(os.path.dirname(__file__), "sumtree.cpp")
+_LIB_CACHE = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build_lib() -> str:
+    cache_dir = os.path.join(tempfile.gettempdir(), "t2omca_native")
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, "libsumtree.so")
+    if (os.path.exists(so_path)
+            and os.path.getmtime(so_path) >= os.path.getmtime(_SRC)):
+        return so_path
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", so_path + ".tmp", _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        detail = getattr(e, "stderr", str(e))
+        raise NativeBuildError(f"g++ build failed: {detail}") from e
+    os.replace(so_path + ".tmp", so_path)
+    return so_path
+
+
+def load_sumtree() -> ctypes.CDLL:
+    """→ CDLL with typed signatures; raises NativeBuildError when no g++."""
+    if "lib" in _LIB_CACHE:
+        return _LIB_CACHE["lib"]
+    lib = ctypes.CDLL(_build_lib())
+    c = ctypes
+    lib.sumtree_create.restype = c.c_void_p
+    lib.sumtree_create.argtypes = [c.c_int64]
+    lib.sumtree_free.argtypes = [c.c_void_p]
+    lib.sumtree_set.argtypes = [c.c_void_p, c.c_int64, c.c_double]
+    lib.sumtree_set_batch.argtypes = [
+        c.c_void_p, c.POINTER(c.c_int64), c.POINTER(c.c_double), c.c_int64]
+    lib.sumtree_total.restype = c.c_double
+    lib.sumtree_total.argtypes = [c.c_void_p]
+    lib.sumtree_get.restype = c.c_double
+    lib.sumtree_get.argtypes = [c.c_void_p, c.c_int64]
+    lib.sumtree_find.restype = c.c_int64
+    lib.sumtree_find.argtypes = [c.c_void_p, c.c_double]
+    lib.sumtree_sample.argtypes = [
+        c.c_void_p, c.POINTER(c.c_double), c.c_int64,
+        c.POINTER(c.c_int64), c.POINTER(c.c_double)]
+    _LIB_CACHE["lib"] = lib
+    return lib

@@ -1,0 +1,107 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has no long-context story — its attention spans ≤ n_agents+3
+entity tokens on one device (SURVEY.md §5.7). This module is the first-class
+scaling path for when the entity axis outgrows a chip (256+ AGVs per env,
+or entity-token models with thousands of entities): shard the TOKEN axis of
+attention across a mesh axis and keep compute local.
+
+Two standard schemes, both pure collectives over ICI (no NCCL analog):
+
+* **Ring attention** (`ring_attention`): K/V blocks rotate around the mesh
+  axis via ``lax.ppermute`` while each device keeps its local Q block and
+  accumulates the softmax *online* (flash-attention-style running max /
+  normalizer), so the full T×T score matrix never exists anywhere. N-1
+  hops overlap with compute; memory per device is O(T/N).
+* **Ulysses all-to-all** (`ulysses_attention`): two ``lax.all_to_all``
+  reshards — tokens→heads before attention, heads→tokens after — so each
+  device computes FULL-sequence attention for a subset of heads. Cheaper
+  collectives for moderate T; requires heads divisible by the axis size.
+
+Both are exact (up to fp reassociation) equivalents of dense softmax
+attention, verified against the dense reference on the virtual 8-device
+mesh in tests/test_ring_attention.py.
+
+Usage is via ``shard_map`` with the token axis sharded on ``axis_name``;
+scaling (e.g. quirk Q1's ``d**-1/4`` on both q and k) is the caller's
+responsibility, exactly like the dense path in ``models/transformer.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _online_block(q, k_blk, v_blk, o, l, m):
+    """Accumulate one K/V block into the running (o, l, m) softmax state.
+
+    q ``(..., Tq, D)``; k_blk/v_blk ``(..., Tk, D)``; o ``(..., Tq, D)``;
+    l, m ``(..., Tq)``.
+    """
+    logits = jnp.einsum("...qd,...kd->...qk", q, k_blk)
+    m_blk = logits.max(axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    alpha = jnp.exp(m - m_new)                       # rescale old state
+    p = jnp.exp(logits - m_new[..., None])
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum("...qk,...kd->...qd", p, v_blk)
+    return o_new, l_new, m_new
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str) -> jnp.ndarray:
+    """Exact softmax attention with the token axis sharded on ``axis_name``.
+
+    Call inside ``shard_map``; per-device shapes ``(..., T_local, D)``.
+    Returns the local block of the attention output. K/V travel the ring
+    once (N-1 ``ppermute`` hops over ICI), Q never moves.
+    """
+    n = lax.psum(1, axis_name)
+    perm = [(j, (j - 1) % n) for j in range(n)]      # pull from the right
+
+    # inits derived from q so shard_map marks them device-varying (fresh
+    # constants would be 'unvarying' and fail the fori_loop carry typecheck)
+    o = q.astype(jnp.float32) * 0.0
+    l = o[..., 0]
+    m = l - jnp.inf
+
+    def body(i, carry):
+        o, l, m, kb, vb = carry
+        o, l, m = _online_block(q.astype(jnp.float32),
+                                kb.astype(jnp.float32),
+                                vb.astype(jnp.float32), o, l, m)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return o, l, m, kb, vb
+
+    o, l, m, _, _ = lax.fori_loop(0, n, body, (o, l, m, k, v))
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name: str) -> jnp.ndarray:
+    """Exact softmax attention via head↔token resharding (DeepSpeed-Ulysses).
+
+    Call inside ``shard_map``; per-device shapes ``(B, T_local, H, D)`` with
+    the token axis sharded on ``axis_name`` and ``H`` divisible by the axis
+    size. Internally: all_to_all → ``(B, T_full, H_local, D)`` → dense
+    attention per local head → all_to_all back.
+    """
+    n = lax.psum(1, axis_name)
+
+    # tokens → heads: split the head axis, gather the token axis
+    reshard = lambda x: lax.all_to_all(x, axis_name, split_axis=2,
+                                       concat_axis=1, tiled=True)
+    qf, kf, vf = reshard(q), reshard(k), reshard(v)   # (B, T, H/n, D)
+
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf.astype(jnp.float32),
+                        kf.astype(jnp.float32))
+    attn = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", attn, vf.astype(jnp.float32))
+
+    # heads → tokens: inverse reshard
+    out = lax.all_to_all(out.astype(q.dtype), axis_name, split_axis=1,
+                         concat_axis=2, tiled=True)
+    return out

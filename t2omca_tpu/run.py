@@ -71,8 +71,6 @@ class Experiment:
         learner = LEARNER_REGISTRY[cfg.learner].build(cfg, mac, env_info)
         runner_cls = RUNNER_REGISTRY[cfg.runner]
         runner = runner_cls(env, mac, cfg)
-        buf_cls = (PrioritizedReplayBuffer if cfg.replay.prioritized
-                   else ReplayBuffer)
         buf_kw = dict(
             capacity=cfg.replay.buffer_size,
             episode_limit=cfg.env_args.episode_limit,
@@ -82,22 +80,38 @@ class Experiment:
             state_dim=env_info["state_shape"],
             store_dtype=cfg.replay.store_dtype,
         )
-        if cfg.replay.prioritized:
-            buf_kw.update(alpha=cfg.replay.per_alpha,
-                          beta0=cfg.replay.per_beta, t_max=cfg.t_max)
-        buffer = buf_cls(**buf_kw)
+        if cfg.replay.buffer_cpu_only:
+            # host-RAM replay with the native sum-tree (reference
+            # buffer_cpu_only semantics: storage on CPU, samples to device)
+            from .components.host_replay import HostReplayBuffer
+            buffer = HostReplayBuffer(
+                alpha=cfg.replay.per_alpha, beta0=cfg.replay.per_beta,
+                t_max=cfg.t_max, prioritized=cfg.replay.prioritized,
+                **buf_kw)
+        else:
+            buf_cls = (PrioritizedReplayBuffer if cfg.replay.prioritized
+                       else ReplayBuffer)
+            if cfg.replay.prioritized:
+                buf_kw.update(alpha=cfg.replay.per_alpha,
+                              beta0=cfg.replay.per_beta, t_max=cfg.t_max)
+            buffer = buf_cls(**buf_kw)
         episode_runner = EpisodeRunner(env, mac, cfg)
         return cls(cfg=cfg, env=env, mac=mac, learner=learner, runner=runner,
                    buffer=buffer, episode_runner=episode_runner)
 
     # ------------------------------------------------------------------ state
 
+    @property
+    def host_buffer(self) -> bool:
+        return getattr(self.buffer, "is_host", False)
+
     def init_train_state(self, seed: int) -> TrainState:
         k_learner, k_runner = jax.random.split(jax.random.PRNGKey(seed))
         return TrainState(
             learner=self.learner.init_state(k_learner),
             runner=self.runner.init_state(k_runner),
-            buffer=self.buffer.init(),
+            # host buffers keep their state outside the jitted pytree
+            buffer=None if self.host_buffer else self.buffer.init(),
             episode=jnp.zeros((), jnp.int32),
         )
 
@@ -120,6 +134,29 @@ class Experiment:
             return rs2, constrain(batch), stats
 
         rollout = jax.jit(_rollout, static_argnames="test_mode")
+
+        if self.host_buffer:
+            # storage lives in host RAM (reference buffer_cpu_only): insert
+            # and sample are host calls, only learner.train is jitted
+            train = jax.jit(learner.train)
+
+            def insert(_ts_buffer, batch):
+                buffer.insert_episode_batch(batch)
+                return None
+
+            def train_iter_host(ts: TrainState, key: jax.Array,
+                                t_env: jnp.ndarray):
+                del key  # host RNG owns sampling
+                batch, idx, weights = buffer.sample(cfg.batch_size,
+                                                    int(t_env))
+                learner_state, info = train(ts.learner, batch, weights,
+                                            t_env, ts.episode)
+                buffer.update_priorities(
+                    idx, jax.device_get(info["td_errors_abs"]) + 1e-6)
+                return ts.replace(learner=learner_state), info
+
+            return rollout, insert, train_iter_host
+
         insert = jax.jit(buffer.insert_episode_batch)
 
         def _train_iter(ts: TrainState, key: jax.Array, t_env: jnp.ndarray):
@@ -229,8 +266,11 @@ def run_sequential(exp: Experiment, logger: Logger,
         train_stats_acc.append(stats)
 
         # ---------------- train gate (reference :220-238) ------------------
-        can = bool(jax.device_get(
-            exp.buffer.can_sample(ts.buffer, cfg.batch_size)))
+        if exp.host_buffer:
+            can = exp.buffer.can_sample(cfg.batch_size)
+        else:
+            can = bool(jax.device_get(
+                exp.buffer.can_sample(ts.buffer, cfg.batch_size)))
         episode = int(jax.device_get(ts.episode))
         if can and episode >= cfg.accumulated_episodes:
             key, k_sample = jax.random.split(key)

@@ -1,0 +1,145 @@
+"""Host-RAM replay + native sum-tree (buffer_cpu_only mode)."""
+
+import numpy as np
+import pytest
+
+from t2omca_tpu.components.host_replay import (HostReplayBuffer, PySumTree)
+
+
+def _native_or_skip(cap):
+    from t2omca_tpu.components.host_replay import NativeSumTree
+    try:
+        return NativeSumTree(cap)
+    except Exception as e:   # no g++ in env
+        pytest.skip(f"native sumtree unavailable: {e}")
+
+
+# ------------------------------------------------------------------ sum-tree
+
+def test_native_sumtree_set_get_total():
+    t = _native_or_skip(10)          # rounds up to 16 leaves
+    t.set_batch(np.array([0, 3, 7]), np.array([1.0, 2.0, 5.0]))
+    assert t.total() == pytest.approx(8.0)
+    assert t.get(np.array([3]))[0] == pytest.approx(2.0)
+    t.set_batch(np.array([3]), np.array([0.5]))
+    assert t.total() == pytest.approx(6.5)
+
+
+def test_native_sumtree_sampling_proportional():
+    t = _native_or_skip(8)
+    pri = np.array([1.0, 0.0, 0.0, 9.0])    # idx 3 has 90% of the mass
+    t.set_batch(np.arange(4), pri)
+    rng = np.random.default_rng(0)
+    idx, p = t.sample(rng.random(1000))
+    frac3 = float(np.mean(idx == 3))
+    assert 0.85 < frac3 < 0.95
+    assert set(np.unique(idx)) <= {0, 3}    # zero-priority never sampled
+    np.testing.assert_allclose(p[idx == 3], 9.0)
+
+
+def test_py_sumtree_matches_native():
+    nat = _native_or_skip(8)
+    py = PySumTree(8)
+    pri = np.array([0.5, 2.0, 0.0, 1.5, 3.0, 0.0, 0.0, 1.0])
+    nat.set_batch(np.arange(8), pri)
+    py.set_batch(np.arange(8), pri)
+    us = np.random.default_rng(1).random(64)
+    i_n, p_n = nat.sample(us)
+    i_p, p_p = py.sample(us)
+    np.testing.assert_array_equal(i_n, i_p)
+    np.testing.assert_allclose(p_n, p_p)
+
+
+# ------------------------------------------------------------------ buffer
+
+def _mk_batch(b, t=3, a=2, n_act=3, obs=4, state=5, seed=0):
+    import jax.numpy as jnp
+    from t2omca_tpu.components.episode_buffer import EpisodeBatch
+    rng = np.random.default_rng(seed)
+    return EpisodeBatch(
+        obs=jnp.asarray(rng.normal(size=(b, t + 1, a, obs)), jnp.float32),
+        state=jnp.asarray(rng.normal(size=(b, t + 1, state)), jnp.float32),
+        avail_actions=jnp.ones((b, t + 1, a, n_act), jnp.int32),
+        actions=jnp.asarray(rng.integers(0, n_act, (b, t, a)), jnp.int32),
+        reward=jnp.asarray(rng.normal(size=(b, t)), jnp.float32),
+        terminated=jnp.zeros((b, t), bool),
+        filled=jnp.ones((b, t), bool),
+    )
+
+
+def _buf(**kw):
+    kw.setdefault("capacity", 8)
+    kw.setdefault("episode_limit", 3)
+    kw.setdefault("n_agents", 2)
+    kw.setdefault("n_actions", 3)
+    kw.setdefault("obs_dim", 4)
+    kw.setdefault("state_dim", 5)
+    kw.setdefault("t_max", 100)
+    return HostReplayBuffer(**kw)
+
+
+def test_host_buffer_roundtrip_and_weights():
+    buf = _buf()
+    assert not buf.can_sample(2)
+    buf.insert_episode_batch(_mk_batch(4, seed=1))
+    assert buf.can_sample(4)
+    batch, idx, w = buf.sample(3, t_env=0)
+    assert batch.obs.shape == (3, 4, 2, 4)
+    assert (np.asarray(idx) < 4).all()
+    assert float(np.max(np.asarray(w))) == pytest.approx(1.0)
+    buf.update_priorities(idx, np.array([5.0, 1.0, 0.1])[: len(idx)])
+    # high-priority episode dominates subsequent samples
+    counts = np.zeros(8)
+    for _ in range(30):
+        _, i2, _ = buf.sample(4, t_env=50)
+        for j in np.asarray(i2):
+            counts[j] += 1
+    assert counts[np.asarray(idx)[0]] == counts.max()
+
+
+def test_host_buffer_ring_wraparound():
+    buf = _buf(capacity=4)
+    buf.insert_episode_batch(_mk_batch(3, seed=2))
+    buf.insert_episode_batch(_mk_batch(3, seed=3))
+    assert buf._count == 4 and buf._pos == 2
+    ref = np.asarray(_mk_batch(3, seed=3).reward)
+    np.testing.assert_allclose(buf._storage.reward[0], ref[1])
+
+
+def test_host_buffer_bf16_storage():
+    buf = _buf(store_dtype="bfloat16")
+    buf.insert_episode_batch(_mk_batch(2, seed=4))
+    batch, _, _ = buf.sample(2, t_env=0)
+    import jax.numpy as jnp
+    assert batch.obs.dtype == jnp.bfloat16
+
+
+def test_host_buffer_end_to_end_training():
+    """Full driver loop with buffer_cpu_only=True (native sum-tree path)."""
+    from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,
+                                   TrainConfig, sanity_check)
+    from t2omca_tpu.run import Experiment
+    import jax
+    import jax.numpy as jnp
+
+    cfg = sanity_check(TrainConfig(
+        batch_size_run=2, batch_size=2,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=4),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1),
+        replay=ReplayConfig(buffer_size=8, buffer_cpu_only=True),
+    ))
+    exp = Experiment.build(cfg)
+    assert exp.host_buffer
+    ts = exp.init_train_state(0)
+    rollout, insert, train_iter = exp.jitted_programs()
+    for _ in range(2):
+        rs, batch, _ = rollout(ts.learner.params["agent"], ts.runner,
+                               test_mode=False)
+        insert(None, batch)
+        ts = ts.replace(runner=rs, episode=ts.episode + cfg.batch_size_run)
+    assert exp.buffer.can_sample(cfg.batch_size)
+    ts2, info = train_iter(ts, jax.random.PRNGKey(0), jnp.asarray(8))
+    assert np.isfinite(float(info["loss"]))
+    assert int(ts2.learner.train_steps) == 1
