@@ -117,40 +117,79 @@ class QMixLearner:
 
     # ------------------------------------------------------------------ unrolls
 
-    def _unroll_agent(self, agent_params, obs_tm: jnp.ndarray
+    @property
+    def needs_rngs(self) -> bool:
+        """True when training must sample noise/dropout masks: NoisyNet
+        sigma params only receive gradient if noise is drawn during the
+        loss unroll (``/root/reference/transf_agent.py:37-48``), and
+        dropout>0 must be active in training."""
+        return (self.cfg.action_selector == "noisy-new"
+                or self.cfg.model.dropout > 0.0)
+
+    def _unroll_agent(self, agent_params, obs_tm: jnp.ndarray,
+                      key: Optional[jax.Array] = None
                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """obs_tm ``(T1, B, A, O)`` → (q ``(T1, B, A, n_actions)``,
-        hiddens ``(T1, B, A, emb)``); carries the recurrent hidden token."""
+        hiddens ``(T1, B, A, emb)``); carries the recurrent hidden token.
+        ``key`` (when the config is noisy / has dropout) drives per-step
+        noise resampling, matching a fresh draw per forward."""
         b = obs_tm.shape[1]
 
-        def body(h, obs_t):
-            q, h = self.mac.forward(agent_params, obs_t, h)
-            return h, (q, h)
+        if key is None:
+            def body(h, obs_t):
+                q, h = self.mac.forward(agent_params, obs_t, h)
+                return h, (q, h)
 
-        _, (qs, hs) = jax.lax.scan(body, self.mac.init_hidden(b), obs_tm)
+            _, (qs, hs) = jax.lax.scan(body, self.mac.init_hidden(b), obs_tm)
+        else:
+            def body(h, xs):
+                obs_t, k_t = xs
+                q, h = self.mac.forward(agent_params, obs_t, h,
+                                        key=k_t, deterministic=False)
+                return h, (q, h)
+
+            keys = jax.random.split(key, obs_tm.shape[0])
+            _, (qs, hs) = jax.lax.scan(
+                body, self.mac.init_hidden(b), (obs_tm, keys))
         return qs, hs
 
     def _unroll_mixer(self, mixer_params, q_tm: jnp.ndarray,
                       hid_tm: jnp.ndarray, state_tm: jnp.ndarray,
-                      obs_tm: jnp.ndarray) -> jnp.ndarray:
+                      obs_tm: jnp.ndarray,
+                      key: Optional[jax.Array] = None) -> jnp.ndarray:
         """q_tm ``(T, B, A)`` → ``q_tot (T, B)``; carries the 3 hyper tokens
         across time (``n_transf_mixer.py:91``)."""
         b = q_tm.shape[1]
 
-        def body(hyper, xs):
-            qv, h, s, o = xs
-            q_tot, hyper = self.mixer.apply(
-                mixer_params, qv[:, None, :], h, hyper, s, o)
-            return hyper, q_tot[:, 0, 0]
+        if key is None:
+            def body(hyper, xs):
+                qv, h, s, o = xs
+                q_tot, hyper = self.mixer.apply(
+                    mixer_params, qv[:, None, :], h, hyper, s, o)
+                return hyper, q_tot[:, 0, 0]
 
-        _, q_tots = jax.lax.scan(
-            body, self.mixer.initial_hyper(b), (q_tm, hid_tm, state_tm, obs_tm))
+            _, q_tots = jax.lax.scan(
+                body, self.mixer.initial_hyper(b),
+                (q_tm, hid_tm, state_tm, obs_tm))
+        else:
+            def body(hyper, xs):
+                qv, h, s, o, k_t = xs
+                q_tot, hyper = self.mixer.apply(
+                    mixer_params, qv[:, None, :], h, hyper, s, o,
+                    deterministic=False, rngs={"dropout": k_t})
+                return hyper, q_tot[:, 0, 0]
+
+            keys = jax.random.split(key, q_tm.shape[0])
+            _, q_tots = jax.lax.scan(
+                body, self.mixer.initial_hyper(b),
+                (q_tm, hid_tm, state_tm, obs_tm, keys))
         return q_tots
 
     # ------------------------------------------------------------------ loss
 
     def _loss(self, params, target_params, batch: EpisodeBatch,
-              weights: jnp.ndarray) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+              weights: jnp.ndarray, key: Optional[jax.Array] = None
+              ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
         cfg = self.cfg
         # time-major views; obs/state may be stored bf16 (ReplayConfig
         # store_dtype) — lift back to f32 for the loss math
@@ -162,27 +201,40 @@ class QMixLearner:
         term = jnp.swapaxes(batch.terminated, 0, 1).astype(jnp.float32)
         mask = jnp.swapaxes(batch.filled, 0, 1).astype(jnp.float32)
 
-        qs, hs = self._unroll_agent(params["agent"], obs)
-        target_qs, target_hs = self._unroll_agent(target_params["agent"], obs)
+        if key is not None:
+            k_ag, k_tag, k_mx, k_tmx = jax.random.split(key, 4)
+        else:
+            k_ag = k_tag = k_mx = k_tmx = None
+
+        qs, hs = self._unroll_agent(params["agent"], obs, k_ag)
+        target_qs, target_hs = self._unroll_agent(
+            target_params["agent"], obs, k_tag)
 
         chosen = jnp.take_along_axis(
             qs[:-1], actions[..., None], axis=-1)[..., 0]  # (T, B, A)
 
-        # illegal actions suppressed in targets (MAC masking contract)
-        masked_next = jnp.where(avail[1:] > 0, qs[1:], -jnp.inf)
+        # illegal actions suppressed in targets (MAC masking contract);
+        # computed over ALL T+1 steps so the target mixer can unroll its
+        # hyper-token recurrence from t=0 with the same history depth as
+        # the online mixer (the targets themselves use steps [1:])
+        masked_all = jnp.where(avail > 0, qs, -jnp.inf)
         if cfg.double_q:
-            best = jnp.argmax(masked_next, axis=-1)        # online argmax
+            best = jnp.argmax(masked_all, axis=-1)         # online argmax
             target_max = jnp.take_along_axis(
-                target_qs[1:], best[..., None], axis=-1)[..., 0]
+                target_qs, best[..., None], axis=-1)[..., 0]
         else:
             target_max = jnp.where(
-                avail[1:] > 0, target_qs[1:], -jnp.inf).max(axis=-1)
+                avail > 0, target_qs, -jnp.inf).max(axis=-1)
 
         q_tot = self._unroll_mixer(
-            params["mixer"], chosen, hs[:-1], state[:-1], obs[:-1])
+            params["mixer"], chosen, hs[:-1], state[:-1], obs[:-1], k_mx)
+        # target unroll spans t=0..T (recurrence semantics of
+        # /root/reference/n_transf_mixer.py:55,91: both nets start their
+        # hyper recurrence at the episode start); outputs [1:] are the
+        # bootstrap values
         target_q_tot = self._unroll_mixer(
-            target_params["mixer"], target_max, target_hs[1:], state[1:],
-            obs[1:])
+            target_params["mixer"], target_max, target_hs, state,
+            obs, k_tmx)[1:]
 
         targets = reward + cfg.gamma * (1.0 - term) * target_q_tot
         td = (q_tot - jax.lax.stop_gradient(targets)) * mask
@@ -205,14 +257,23 @@ class QMixLearner:
 
     def train(self, ls: LearnerState, batch: EpisodeBatch,
               weights: jnp.ndarray, t_env: jnp.ndarray,
-              episode: jnp.ndarray
+              episode: jnp.ndarray, key: Optional[jax.Array] = None
               ) -> Tuple[LearnerState, Dict[str, jnp.ndarray]]:
         """One importance-weighted QMIX update; hard target sync every
-        ``target_update_interval`` episodes (PyMARL convention, M8)."""
+        ``target_update_interval`` episodes (PyMARL convention, M8).
+        ``key`` drives NoisyLinear/dropout sampling and is required when the
+        config uses either (otherwise sigma params get zero gradient)."""
         del t_env
+        if self.needs_rngs and key is None:
+            raise ValueError(
+                "QMixLearner.train needs a PRNG key when "
+                "action_selector='noisy-new' or dropout>0 (noise/dropout "
+                "must be sampled during the loss unroll)")
+        if not self.needs_rngs:
+            key = None   # identical program for all callers in the pure path
         opt = _make_optimizer(self.cfg)
         grads, info = jax.grad(self._loss, has_aux=True)(
-            ls.params, ls.target_params, batch, weights)
+            ls.params, ls.target_params, batch, weights, key)
         info["grad_norm"] = optax.global_norm(grads)
         updates, opt_state = opt.update(grads, ls.opt_state, ls.params)
         params = optax.apply_updates(ls.params, updates)

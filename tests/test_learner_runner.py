@@ -137,6 +137,89 @@ def test_target_network_hard_sync_at_interval(setup):
     assert int(ls2.last_target_update) == cfg.target_update_interval
 
 
+def test_target_mixer_unrolls_from_episode_start(setup):
+    """The target mixer's hyper-token recurrence must start at t=0 like the
+    online mixer's (``/root/reference/n_transf_mixer.py:55,91``): targets are
+    the [1:] outputs of a full T+1-step unroll, NOT a fresh recurrence started
+    at t=1 (which would give the target one step less history at every t)."""
+    cfg, env, info, mac, learner, runner, ls, rs, run = setup
+    rs, batch, _ = run(ls.params["agent"], rs, test_mode=False)
+    w = jnp.ones((cfg.batch_size_run,))
+    _, linfo = learner._loss(ls.params, ls.target_params, batch, w)
+
+    # oracle: replicate the target computation with an explicit full-length
+    # unroll from t=0 and compare the resulting masked target mean
+    obs = jnp.swapaxes(batch.obs, 0, 1).astype(jnp.float32)
+    state = jnp.swapaxes(batch.state, 0, 1).astype(jnp.float32)
+    avail = jnp.swapaxes(batch.avail_actions, 0, 1)
+    reward = jnp.swapaxes(batch.reward, 0, 1)
+    term = jnp.swapaxes(batch.terminated, 0, 1).astype(jnp.float32)
+    mask = jnp.swapaxes(batch.filled, 0, 1).astype(jnp.float32)
+
+    qs, _ = learner._unroll_agent(ls.params["agent"], obs)
+    tqs, ths = learner._unroll_agent(ls.target_params["agent"], obs)
+    best = jnp.argmax(jnp.where(avail > 0, qs, -jnp.inf), axis=-1)
+    tmax = jnp.take_along_axis(tqs, best[..., None], axis=-1)[..., 0]
+    # full unroll t=0..T with the target params, bootstrap values = [1:]
+    t_qtot = learner._unroll_mixer(ls.target_params["mixer"], tmax, ths,
+                                   state, obs)[1:]
+    targets = reward + cfg.gamma * (1.0 - term) * t_qtot
+    expect = float((targets * mask).sum() / jnp.maximum(mask.sum(), 1.0))
+    assert np.isclose(float(linfo["target_mean"]), expect, rtol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def noisy_setup():
+    cfg = sanity_check(TrainConfig(
+        batch_size_run=2, batch_size=2, action_selector="noisy-new",
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=5),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1, dropout=0.1),
+        replay=ReplayConfig(buffer_size=8),
+    ))
+    env = make_env(cfg.env_args)
+    info = env.get_env_info()
+    mac = BasicMAC.build(cfg, info)
+    learner = QMixLearner.build(cfg, mac, info)
+    runner = ParallelRunner(env, mac, cfg)
+    ls = learner.init_state(jax.random.PRNGKey(0))
+    rs = runner.init_state(jax.random.PRNGKey(1))
+    run = jax.jit(runner.run, static_argnames="test_mode")
+    rs, batch, _ = run(ls.params["agent"], rs, test_mode=False)
+    return cfg, learner, ls, batch
+
+
+def test_noisy_sigma_params_receive_gradient(noisy_setup):
+    """NoisyNet semantics (``/root/reference/transf_agent.py:37-48``): the
+    sigma parameters must be trained, i.e. noise is sampled during the loss
+    unroll and grads flow into ``w_sigma``/``b_sigma``."""
+    cfg, learner, ls, batch = noisy_setup
+    assert learner.needs_rngs
+    w = jnp.ones((cfg.batch_size_run,))
+    grads, _ = jax.grad(learner._loss, has_aux=True)(
+        ls.params, ls.target_params, batch, w, jax.random.PRNGKey(7))
+    q_grads = grads["agent"]["params"]["q_basic"]
+    for name in ("w_sigma", "b_sigma"):
+        g = np.asarray(q_grads[name])
+        assert np.abs(g).max() > 0, f"{name} gradient is zero"
+
+
+def test_noisy_train_requires_key(noisy_setup):
+    cfg, learner, ls, batch = noisy_setup
+    w = jnp.ones((cfg.batch_size_run,))
+    with pytest.raises(ValueError, match="PRNG key"):
+        learner.train(ls, batch, w, jnp.asarray(0), jnp.asarray(0))
+    ls2, tinfo = jax.jit(learner.train)(ls, batch, w, jnp.asarray(0),
+                                        jnp.asarray(0),
+                                        jax.random.PRNGKey(3))
+    assert np.isfinite(float(tinfo["loss"]))
+    # sigma params actually move under the optimizer
+    before = ls.params["agent"]["params"]["q_basic"]["w_sigma"]
+    after = ls2.params["agent"]["params"]["q_basic"]["w_sigma"]
+    assert not np.allclose(before, after)
+
+
 def test_mixer_monotonic_in_agent_qs(setup):
     """QMIX monotonicity: dq_tot/dq_a ≥ 0 through pos_func (SURVEY.md §4(2))."""
     cfg, env, info, mac, learner, runner, ls, rs, run = setup
