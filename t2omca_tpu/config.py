@@ -35,6 +35,10 @@ class EnvConfig:
     state_entity_mode: bool = True
     state_last_action: bool = False
     edge_only: bool = False
+    # perf mode: one order-free batched Welford update per step instead of
+    # the reference's sequential per-agent loop (O(A/n) transient deviation;
+    # see envs/normalization.py:welford_update_batch)
+    fast_norm: bool = False
 
     # ----- physics / M1 spec values (frozen in docs/SPEC.md §1; the reference
     # does not release data_struct_multiagv, so these are our pinned choices)

@@ -49,7 +49,7 @@ from flax import struct
 
 from ..config import EnvConfig
 from .critic import critic
-from .normalization import NormState, normalize
+from .normalization import NormState, normalize, normalize_batch
 
 
 def _round(x: jnp.ndarray, decimals: int = 0) -> jnp.ndarray:
@@ -133,7 +133,12 @@ class MultiAgvOffloadingEnv:
 
     @property
     def state_entity_feats(self) -> int:
-        return 8  # ack_onehot(3) + agent_inf(5)
+        # ack_onehot(3) + agent_inf(5); with state_last_action the per-agent
+        # action one-hot joins the state (reference env_info arithmetic
+        # divides the flat state length by n_agents, :435-438)
+        if self.cfg.state_last_action:
+            return 8 + self.n_actions
+        return 8
 
     @property
     def obs_dim(self) -> int:
@@ -227,12 +232,19 @@ class MultiAgvOffloadingEnv:
 
     def get_obs(self, state: EnvState,
                 update_norm: bool = True) -> Tuple[EnvState, jnp.ndarray]:
-        """Normalized per-agent observations. The Welford state is updated
-        agent-by-agent in order, each agent normalized with the statistics
-        *after its own update* — exactly the reference's sequential
-        ``[self.obs_norm(self.get_obs_agent(i)) for i in range(n)]``
-        (``:184-186``, quirks Q4/Q5)."""
+        """Normalized per-agent observations. Default path: the Welford
+        state is updated agent-by-agent in order, each agent normalized with
+        the statistics *after its own update* — exactly the reference's
+        sequential ``[self.obs_norm(self.get_obs_agent(i)) for i in
+        range(n)]`` (``:184-186``, quirks Q4/Q5). With ``cfg.fast_norm`` the
+        A-step sequential scan (the env-step serialization bottleneck at 64
+        agents) becomes one order-free batched merge; equivalence-tolerance
+        test in ``tests/test_normalization.py``."""
         raw = self._raw_obs(state)
+
+        if self.cfg.fast_norm:
+            norm, obs = normalize_batch(state.norm, raw, update=update_norm)
+            return state.replace(norm=norm), obs
 
         def body(carry: NormState, x):
             carry, y = normalize(carry, x, update=update_norm)
@@ -243,10 +255,17 @@ class MultiAgvOffloadingEnv:
 
     def get_state(self, state: EnvState) -> jnp.ndarray:
         """Global state: all-agent ACK one-hots ++ all-agent agent_inf rows,
-        flattened (reference ``get_state`` :188-204); not normalized."""
+        flattened (reference ``get_state`` :188-204); not normalized. With
+        ``state_last_action`` the per-agent action one-hots are prepended —
+        the reference declares the flag (:11) and keeps the concat slot
+        commented (:196); wiring it preserves that config surface."""
         ack1h = self._ack_onehot(state.last_ack)
         inf = self._agent_inf(state)
-        return jnp.concatenate([ack1h.reshape(-1), inf.reshape(-1)])
+        parts = [ack1h.reshape(-1), inf.reshape(-1)]
+        if self.cfg.state_last_action:
+            la1h = jax.nn.one_hot(state.last_action, self.n_actions)
+            parts.insert(0, la1h.reshape(-1))
+        return jnp.concatenate(parts)
 
     def get_avail_actions(self, state: EnvState) -> jnp.ndarray:
         """(A, n_actions) availability (reference :61-82): empty buffer ⇒ only

@@ -318,3 +318,33 @@ def test_step_is_jittable_and_deterministic():
     out2 = step(st, a, jax.random.PRNGKey(3))
     np.testing.assert_allclose(np.asarray(out1[1]), np.asarray(out2[1]))
     np.testing.assert_allclose(np.asarray(out1[0].pos), np.asarray(out2[0].pos))
+
+
+def test_fast_norm_env_equivalence():
+    """fast_norm changes only get_obs: running statistics stay in lockstep
+    with the sequential reference path along a shared trajectory, and the
+    normalized observations converge (O(A/n) transient)."""
+    env_seq = make_env()
+    env_fast = make_env(fast_norm=True)
+    st, obs_seq, *_ = env_seq.reset(KEY)
+    fast_norm = env_fast.get_obs(st.replace(norm=NormState.create(
+        env_fast.obs_dim)))[0].norm
+    key = jax.random.PRNGKey(11)
+    devs = []
+    for t in range(40):
+        key, ka, ks = jax.random.split(key, 3)
+        actions = jax.random.randint(ka, (env_seq.n_agents,), 0,
+                                     env_seq.n_actions)
+        avail = env_seq.get_avail_actions(st)
+        actions = jnp.where(avail[jnp.arange(4), actions] > 0, actions, 0)
+        st, _, _, _, obs_seq, _, _ = env_seq.step(st, actions, ks)
+        # same post-step state, fast normalizer carried independently
+        fst, obs_fast = env_fast.get_obs(st.replace(norm=fast_norm))
+        fast_norm = fst.norm
+        devs.append(float(jnp.abs(obs_fast - obs_seq).max()))
+    assert int(fast_norm.n) == int(st.norm.n)
+    np.testing.assert_allclose(np.asarray(fast_norm.mean),
+                               np.asarray(st.norm.mean), rtol=1e-3, atol=1e-3)
+    # the two paths' outputs converge after warm-up
+    assert np.mean(devs[-10:]) < np.mean(devs[:10])
+    assert devs[-1] < 0.15, devs[-5:]

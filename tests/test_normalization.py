@@ -5,8 +5,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from t2omca_tpu.envs.normalization import (NormState, RewardScaleState,
-                                           normalize, reset_reward_scale,
-                                           scale_reward, welford_update)
+                                           normalize, normalize_batch,
+                                           reset_reward_scale, scale_reward,
+                                           welford_update,
+                                           welford_update_batch)
 
 
 class NumpyOracle:
@@ -59,6 +61,61 @@ def test_no_update_path_q4():
     st = NormState.create(2)
     st, _ = normalize(st, jnp.asarray([1.0, 2.0]))
     st2, _ = normalize(st, jnp.asarray([5.0, 5.0]), update=False)
+    assert int(st2.n) == int(st.n)
+    np.testing.assert_allclose(np.asarray(st2.mean), np.asarray(st.mean))
+
+
+def test_batched_welford_stats_match_sequential():
+    """The order-free batched merge (fast_norm path) must produce the SAME
+    running statistics as A sequential updates once n >= 1 (Chan's combine
+    telescopes); starting from n == 0 it skips only the Q5 std quirk."""
+    rng = np.random.default_rng(2)
+    a, dim = 8, 5
+    st_seq = NormState.create(dim)
+    st_bat = NormState.create(dim)
+    for step in range(12):
+        xs = rng.normal(2.0, 3.0, size=(a, dim)).astype(np.float32)
+        for x in xs:
+            st_seq = welford_update(st_seq, jnp.asarray(x))
+        st_bat = welford_update_batch(st_bat, jnp.asarray(xs))
+        assert int(st_bat.n) == int(st_seq.n) == a * (step + 1)
+        np.testing.assert_allclose(np.asarray(st_bat.mean),
+                                   np.asarray(st_seq.mean), rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(st_bat.s),
+                                   np.asarray(st_seq.s), rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(st_bat.std),
+                                   np.asarray(st_seq.std), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_batched_normalize_converges_to_sequential():
+    """Normalized outputs: each agent sees post-merge stats instead of its
+    own prefix — an O(A/n) transient. After warm-up the two paths must agree
+    to tight tolerance (the fast_norm equivalence contract)."""
+    rng = np.random.default_rng(3)
+    a, dim = 8, 5
+    st_seq = NormState.create(dim)
+    st_bat = NormState.create(dim)
+    max_dev = []
+    for step in range(60):
+        xs = jnp.asarray(rng.normal(1.0, 2.0, size=(a, dim)).astype(np.float32))
+        ys_seq = []
+        for x in xs:
+            st_seq, y = normalize(st_seq, x)
+            ys_seq.append(np.asarray(y))
+        st_bat, ys_bat = normalize_batch(st_bat, xs)
+        max_dev.append(np.abs(np.stack(ys_seq) - np.asarray(ys_bat)).max())
+    # deviation decays roughly as A/n: late-phase obs agree tightly
+    assert max_dev[-1] < 0.02, max_dev[-5:]
+    assert np.mean(max_dev[-10:]) < np.mean(max_dev[:10])
+
+
+def test_batched_normalize_no_update_path():
+    st = NormState.create(2)
+    st = welford_update_batch(st, jnp.ones((4, 2)) * jnp.asarray([1.0, 2.0]))
+    st2, _ = normalize_batch(st, jnp.full((4, 2), 9.0), update=False)
     assert int(st2.n) == int(st.n)
     np.testing.assert_allclose(np.asarray(st2.mean), np.asarray(st.mean))
 
