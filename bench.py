@@ -24,6 +24,76 @@ import sys
 import time
 
 
+def breakdown(cfg, exp, ts, _time, args) -> int:
+    """Attribute the rollout slot time (stderr table + one JSON line)."""
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+
+    env, mac = exp.env, exp.mac
+    b, t_len = cfg.batch_size_run, cfg.env_args.episode_limit
+    params = ts.learner.params["agent"]
+    rs = ts.runner
+    rows = {}
+
+    def env_only(env_obj):
+        def run(rs_states, key):
+            def step_fn(carry, key_t):
+                states, t = carry
+                actions = jax.random.randint(
+                    key_t, (b, env_obj.n_agents), 0, env_obj.n_actions)
+                # empty-buffer lanes must take action 0 (legal projection)
+                actions = actions * states.job_valid[:, :, 0]
+                states, reward, *_ = jax.vmap(env_obj.step)(
+                    states, actions, jax.random.split(key_t, b))
+                return (states, t + 1), reward
+            (states, _), rewards = jax.lax.scan(
+                step_fn, (rs_states, 0), jax.random.split(key, t_len))
+            return rewards.sum()
+        return jax.jit(run)
+
+    for label, fn in (("env_seq", False), ("env_fast", True)):
+        e = dataclasses.replace(
+            env, cfg=dataclasses.replace(env.cfg, fast_norm=fn))
+        prog = env_only(e)
+        rows[label] = _time(lambda p=prog: p(rs.env_states,
+                                             jax.random.PRNGKey(0)))
+
+    # acting-only: T sequential MAC forwards on a fixed obs batch
+    obs = jnp.zeros((b, env.n_agents, env.obs_dim),
+                    jnp.dtype(cfg.model.dtype))
+    avail = jnp.ones((b, env.n_agents, env.n_actions), jnp.int32)
+
+    def acting(params):
+        def step_fn(carry, key_t):
+            hidden, t_env = carry
+            actions, hidden, _ = mac.select_actions(
+                params, obs, avail, hidden, key_t, t_env, test_mode=False)
+            return (hidden, t_env + b), actions.sum()
+        (_, _), outs = jax.lax.scan(
+            step_fn, (mac.init_hidden(b), jnp.zeros((), jnp.int32)),
+            jax.random.split(jax.random.PRNGKey(1), t_len))
+        return outs.sum()
+
+    rows["acting"] = _time(lambda: jax.jit(acting)(params))
+
+    rollout = jax.jit(exp.runner.run, static_argnames="test_mode")
+    def full():
+        _, batch, _ = rollout(params, rs, test_mode=False)
+        return batch.reward[0, 0]
+    rows["full"] = _time(full)
+
+    env_steps = b * t_len
+    print(f"# breakdown at {b} envs x {t_len} slots "
+          f"({cfg.env_args.agv_num} AGVs, d{cfg.model.emb}, "
+          f"pallas={cfg.model.use_pallas})", file=sys.stderr)
+    for k, v in rows.items():
+        print(f"#   {k:10s} {v * 1e3:8.1f} ms "
+              f"({env_steps / v:,.0f} env-steps/s)", file=sys.stderr)
+    print(json.dumps({k: round(env_steps / v, 1) for k, v in rows.items()}))
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
@@ -33,6 +103,12 @@ def main() -> int:
     ap.add_argument("--no-pallas", action="store_true",
                     help="XLA acting path (reproduces the BASELINE.md "
                          "XLA-path row)")
+    ap.add_argument("--no-fast-norm", action="store_true",
+                    help="sequential per-agent Welford (reference-exact "
+                         "normalizer ordering) instead of the batched merge")
+    ap.add_argument("--breakdown", action="store_true",
+                    help="attribute the slot time: env-only rollout "
+                         "(seq vs fast norm), acting-only scan, full rollout")
     args = ap.parse_args()
 
     if args.smoke:
@@ -68,7 +144,8 @@ def main() -> int:
         cfg = sanity_check(TrainConfig(
             batch_size_run=n_envs,
             env_args=EnvConfig(agv_num=64, mec_num=8, num_channels=8,
-                               episode_limit=steps),
+                               episode_limit=steps,
+                               fast_norm=not args.no_fast_norm),
             model=ModelConfig(emb=256, heads=4, depth=2, mixer_emb=256,
                               mixer_heads=4, mixer_depth=2,
                               standard_heads=True, dtype="bfloat16",
@@ -87,6 +164,20 @@ def main() -> int:
         # device→host fetch: the only reliable barrier under the axon remote
         # tunnel, where block_until_ready on async futures returns early
         return float(np.asarray(x))
+
+    def _time(fn, iters=args.iters):
+        """median seconds of fn() (fn must return an array to sync on)."""
+        fn_times = []
+        _sync(fn())   # warm-up beyond compile
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            _sync(fn())
+            fn_times.append(time.perf_counter() - t0)
+        fn_times.sort()
+        return fn_times[len(fn_times) // 2]
+
+    if args.breakdown:
+        return breakdown(cfg, exp, ts, _time, args)
 
     # compile + warm-up (two runs: tunnel queues make the first timed run
     # unrepresentative)

@@ -102,6 +102,11 @@ class ReplayBuffer:
     state_dim: int
     store_dtype: str = "float32"   # obs/state storage dtype (HBM budget)
 
+    def __post_init__(self):
+        if self.capacity < 1:
+            raise ValueError(f"buffer capacity must be >= 1, got "
+                             f"{self.capacity}")
+
     def init(self) -> BufferState:
         return BufferState(
             storage=_zeros_like_episode(
@@ -121,6 +126,13 @@ class ReplayBuffer:
         max priority (standard PER; reference feeds real |TD| back after the
         first sample, Q9)."""
         b = batch.batch_size
+        if b > self.capacity:
+            # ring indices would repeat within one scatter and XLA's order
+            # for duplicate indices is unspecified → arbitrary contents
+            raise ValueError(
+                f"insert batch of {b} episodes exceeds buffer capacity "
+                f"{self.capacity}; raise replay.buffer_size above "
+                f"batch_size_run")
         idx = (state.insert_pos + jnp.arange(b)) % self.capacity
         storage = jax.tree.map(
             lambda s, x: s.at[idx].set(x), state.storage, batch)

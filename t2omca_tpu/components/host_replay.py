@@ -170,7 +170,22 @@ class HostReplayBuffer:
         if self.prioritized:
             us = self._rng.random(batch_size)
             idx, pri_a = self._tree.sample(us)
-            idx = np.minimum(idx, n - 1)
+            # unfilled slots carry zero priority, so a hit there can only be
+            # an exact right-edge float artifact (u·total == total): redraw
+            # instead of clamping, which would silently over-sample the last
+            # valid episode; persistent hits mean corrupted bookkeeping
+            oob = idx >= n
+            tries = 0
+            while oob.any():
+                if tries >= 3:
+                    raise RuntimeError(
+                        "sum-tree repeatedly sampled unfilled slots — "
+                        "priority bookkeeping is corrupted")
+                ridx, rpri = self._tree.sample(
+                    self._rng.random(int(oob.sum())))
+                idx[oob], pri_a[oob] = ridx, rpri
+                oob = idx >= n
+                tries += 1
             total = self._tree.total()
             probs = pri_a / max(total, 1e-12)
             beta = self.beta0 + (1.0 - self.beta0) * min(

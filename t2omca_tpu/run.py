@@ -37,6 +37,8 @@ from .runners.parallel_runner import ParallelRunner, RunnerState
 from .utils.checkpoint import (find_checkpoint, load_checkpoint,
                                save_checkpoint)
 from .utils.logging import Logger
+from .utils.profiling import StageTimer, TraceWindow
+from .utils.stats import StatsAccumulator
 from .utils.timehelper import time_left, time_str
 
 
@@ -146,11 +148,12 @@ class Experiment:
 
             def train_iter_host(ts: TrainState, key: jax.Array,
                                 t_env: jnp.ndarray):
-                del key  # host RNG owns sampling
+                # host RNG owns sampling; key seeds noise/dropout (train
+                # ignores it for pure configs)
                 batch, idx, weights = buffer.sample(cfg.batch_size,
                                                     int(t_env))
                 learner_state, info = train(ts.learner, batch, weights,
-                                            t_env, ts.episode)
+                                            t_env, ts.episode, key)
                 buffer.update_priorities(
                     idx, jax.device_get(info["td_errors_abs"]) + 1e-6)
                 return ts.replace(learner=learner_state), info
@@ -161,41 +164,17 @@ class Experiment:
 
         def _train_iter(ts: TrainState, key: jax.Array, t_env: jnp.ndarray):
             """sample → train → priority feedback, as one program."""
+            k_sample, k_learn = jax.random.split(key)
             batch, idx, weights = buffer.sample(
-                ts.buffer, key, cfg.batch_size, t_env)
+                ts.buffer, k_sample, cfg.batch_size, t_env)
             learner_state, info = learner.train(
-                ts.learner, constrain(batch), weights, t_env, ts.episode)
+                ts.learner, constrain(batch), weights, t_env, ts.episode,
+                k_learn)
             buf = buffer.update_priorities(
                 ts.buffer, idx, info["td_errors_abs"] + 1e-6)   # Q9
             return ts.replace(learner=learner_state, buffer=buf), info
 
         return rollout, insert, jax.jit(_train_iter)
-
-
-def _log_rollout_stats(logger: Logger, stats, t_env: int,
-                       prefix: str = "") -> None:
-    """Mean-aggregate a RolloutStats over the env axis and log with the
-    reference's key set (``parallel_runner.py:202-231``, SURVEY.md §5.5)."""
-    s = jax.device_get(stats)
-    n = max(len(np.atleast_1d(s.episode_return)), 1)
-    logger.log_stat(prefix + "return_mean",
-                    float(np.sum(s.episode_return)) / n, t_env)
-    logger.log_stat(prefix + "ep_length_mean",
-                    float(np.sum(s.episode_length)) / n, t_env)
-    t_per_ep = max(float(np.mean(s.episode_length)), 1.0)
-    for k in ("delay_reward", "overtime_penalty", "channel_utilization_rate",
-              "conflict_ratio"):
-        # reference sums per-step infos over the episode then means per ep;
-        # utilization/conflict are per-step rates so divide by length too
-        v = float(np.sum(getattr(s, k))) / n
-        if k in ("channel_utilization_rate", "conflict_ratio"):
-            v /= t_per_ep
-        logger.log_stat(prefix + k + "_mean", v, t_env)
-    for k in ("task_completion_rate", "task_completion_delay"):
-        logger.log_stat(prefix + k + "_mean",
-                        float(np.sum(getattr(s, k))) / n, t_env)
-    if not prefix:
-        logger.log_stat("epsilon", float(np.mean(s.epsilon)), t_env)
 
 
 def run(cfg: TrainConfig, logger: Optional[Logger] = None) -> TrainState:
@@ -212,7 +191,9 @@ def run(cfg: TrainConfig, logger: Optional[Logger] = None) -> TrainState:
     logger.console_logger.info(f"Experiment token: {token}")
 
     exp = Experiment.build(cfg)
-    if cfg.evaluate or cfg.save_replay or cfg.save_animation:
+    # reference dispatch (per_run.py:192): save_animation alone does NOT
+    # divert to evaluation — it enables the in-training animation cadence
+    if cfg.evaluate or cfg.save_replay:
         return evaluate_sequential(exp, logger, results_dir)
     return run_sequential(exp, logger, results_dir)
 
@@ -252,18 +233,38 @@ def run_sequential(exp: Experiment, logger: Logger,
     start_time = last_time = time.time()
     start_t = last_T = t_env
     n_test_runs = max(1, cfg.test_nepisode // cfg.batch_size_run)
+    test_quota = n_test_runs * cfg.batch_size_run      # Q10 rounded quota
     train_infos = []
-    train_stats_acc = []
+    # terminal-info stat accumulation (reference parallel_runner.py:202-231)
+    train_acc = StatsAccumulator()
+    test_acc = StatsAccumulator()
+    last_runner_log_t = t_env
+    # in-training animation cadence (reference per_run.py:258-263)
+    last_anim_t = -cfg.animation_interval - 1
+    er_rs = None
+    # tracing/profiling (SURVEY.md §5(1)): per-stage wall-clock into the
+    # metric stream + optional jax.profiler trace window over the hot loop
+    timer = StageTimer()
+    tracer = TraceWindow(cfg.profile_dir, cfg.profile_start,
+                         cfg.profile_iterations)
 
     while t_env <= cfg.t_max:
+        tracer.maybe_start(t_env)
         # ---------------- rollout (no grad by construction) ----------------
-        rs, batch, stats = rollout(ts.learner.params["agent"], ts.runner,
-                                   test_mode=False)
-        ts = ts.replace(runner=rs,
-                        buffer=insert(ts.buffer, batch),
-                        episode=ts.episode + cfg.batch_size_run)
-        t_env = int(jax.device_get(rs.t_env))
-        train_stats_acc.append(stats)
+        with timer.stage("rollout"):
+            rs, batch, stats = rollout(ts.learner.params["agent"], ts.runner,
+                                       test_mode=False)
+            ts = ts.replace(runner=rs,
+                            buffer=insert(ts.buffer, batch),
+                            episode=ts.episode + cfg.batch_size_run)
+            t_env = int(jax.device_get(rs.t_env))
+        train_acc.push(stats)
+        # train-stat cadence: runner_log_interval, epsilon alongside
+        # (reference parallel_runner.py:215-219)
+        if t_env - last_runner_log_t >= cfg.runner_log_interval:
+            train_acc.flush(logger, t_env)
+            logger.log_stat("epsilon", train_acc.epsilon, t_env)
+            last_runner_log_t = t_env
 
         # ---------------- train gate (reference :220-238) ------------------
         if exp.host_buffer:
@@ -274,8 +275,11 @@ def run_sequential(exp: Experiment, logger: Logger,
         episode = int(jax.device_get(ts.episode))
         if can and episode >= cfg.accumulated_episodes:
             key, k_sample = jax.random.split(key)
-            ts, info = train_iter(ts, k_sample, jnp.asarray(t_env))
+            with timer.stage("train"):
+                ts, info = train_iter(ts, k_sample, jnp.asarray(t_env))
+                jax.block_until_ready(info["loss"])
             train_infos.append(info)
+        tracer.tick(logger)
 
         # ---------------- test cadence (reference :240-256) ----------------
         if (t_env - last_test_t) / cfg.test_interval >= 1.0:
@@ -286,17 +290,31 @@ def run_sequential(exp: Experiment, logger: Logger,
                 f"Time passed: {time_str(time.time() - start_time)}")
             last_time, last_T = time.time(), t_env
 
-            test_stats = []
-            for _ in range(n_test_runs):
-                rs, _, s = rollout(ts.learner.params["agent"], ts.runner,
-                                   test_mode=True)
-                ts = ts.replace(runner=rs)
-                test_stats.append(s)
-            merged = jax.tree.map(
-                lambda *xs: np.concatenate([np.atleast_1d(x) for x in xs]),
-                *test_stats)
-            _log_rollout_stats(logger, merged, t_env, prefix="test_")
+            with timer.stage("test"):
+                for _ in range(n_test_runs):
+                    rs, _, s = rollout(ts.learner.params["agent"], ts.runner,
+                                       test_mode=True)
+                    ts = ts.replace(runner=rs)
+                    test_acc.push(s)
+                    # Q10: flush only on the exact rounded quota
+                    if test_acc.n_episodes == test_quota:
+                        test_acc.flush(logger, t_env, prefix="test_")
             last_test_t = t_env
+
+        # ---------------- animation cadence (reference :258-263) -----------
+        if (cfg.save_animation
+                and (t_env - last_anim_t) / cfg.animation_interval >= 1.0):
+            er = exp.episode_runner
+            if er_rs is None:
+                er_rs = er.init_state(jax.random.PRNGKey(cfg.seed + 3))
+            er_rs, _, _, traj = er.run(ts.learner.params["agent"], er_rs,
+                                       test_mode=True,
+                                       capture_trajectory=True)
+            p = er.save_animation(
+                traj, os.path.join(results_dir, f"animation_{t_env}.gif"))
+            if p:
+                log.info(f"animation saved to {p}")
+            last_anim_t = t_env
 
         # ---------------- save cadence (reference :265-279) ----------------
         if cfg.save_model and (t_env - last_save_t) >= cfg.save_model_interval:
@@ -306,11 +324,6 @@ def run_sequential(exp: Experiment, logger: Logger,
 
         # ---------------- log cadence (reference :283-286) ------------------
         if (t_env - last_log_t) >= cfg.log_interval:
-            merged = jax.tree.map(
-                lambda *xs: np.concatenate([np.atleast_1d(x) for x in xs]),
-                *train_stats_acc)
-            _log_rollout_stats(logger, merged, t_env)
-            train_stats_acc = []
             if train_infos:
                 last = jax.device_get(train_infos[-1])
                 for k in ("loss", "grad_norm", "td_error_abs",
@@ -318,6 +331,7 @@ def run_sequential(exp: Experiment, logger: Logger,
                     logger.log_stat(k, float(last[k]), t_env)
                 train_infos = []
             logger.log_stat("episode", episode, t_env)
+            timer.log_and_reset(logger, t_env)
             logger.print_recent_stats()
             last_log_t = t_env
 
@@ -355,13 +369,25 @@ def evaluate_sequential(exp: Experiment, logger: Logger,
              f"return_mean={np.mean(returns):.3f} ± {np.std(returns):.3f}")
     logger.log_stat("test_return_mean", float(np.mean(returns)), 0)
 
+    # reference per_run.py:85,92: in full-evaluate mode only every
+    # ``animation_interval_evaluation``-th episode is rendered/animated
+    anim_every = max(cfg.animation_interval_evaluation, 1)
+    anim_eps = [i for i in range(len(trajs))
+                if not cfg.evaluate or i % anim_every == 0]
     if cfg.save_replay:
-        p = er.save_replay(trajs[0], os.path.join(results_dir, "replay.npz"))
-        log.info(f"replay saved to {p}")
+        for i in anim_eps:
+            p = er.save_replay(trajs[i],
+                               os.path.join(results_dir,
+                                            f"replay_episode_{i}.npz"))
+        log.info(f"replays saved to {results_dir} ({len(anim_eps)} episodes)")
     if cfg.save_animation:
-        p = er.save_animation(trajs[0],
-                              os.path.join(results_dir, "animation.gif"))
-        log.info(f"animation saved to {p}")
+        for i in anim_eps:
+            p = er.save_animation(
+                trajs[i], os.path.join(results_dir,
+                                       f"animation_episode_{i}.gif"))
+        if p:
+            log.info(f"animations saved to {results_dir} "
+                     f"({len(anim_eps)} episodes)")
     if cfg.benchmark_mode:
         # reference exports CSVs only in benchmark mode (per_run.py:96-101)
         p = er.benchmark_csv(trajs, os.path.join(results_dir,

@@ -55,15 +55,23 @@ class RunnerState:
 
 @struct.dataclass
 class RolloutStats:
-    """Per-rollout aggregates (summed over envs, reference ``:202-219``)."""
+    """Per-rollout stats with the reference's terminal-info semantics
+    (``/root/reference/parallel_runner.py:168-170,226-231``): the logged
+    ``<k>_mean`` keys aggregate the info dict of the TERMINAL step only
+    (the reference collects ``final_env_infos`` at termination and sums
+    those), not per-step sums. All info fields here are the terminal-step
+    values per env lane; ``episode_return``/``episode_length`` feed
+    ``return_mean`` and ETA accounting."""
 
-    episode_return: jnp.ndarray            # (B,)
+    episode_return: jnp.ndarray            # (B,) summed reward (return_mean)
     episode_length: jnp.ndarray            # (B,)
-    delay_reward: jnp.ndarray              # (B,) summed over t
+    reward: jnp.ndarray                    # (B,) terminal-step values below
+    delay_reward: jnp.ndarray              # (B,)
     overtime_penalty: jnp.ndarray          # (B,)
-    channel_utilization_rate: jnp.ndarray  # (B,) summed over t
+    channel_utilization_rate: jnp.ndarray  # (B,)
     conflict_ratio: jnp.ndarray            # (B,)
-    task_completion_rate: jnp.ndarray      # (B,) terminal-step value
+    episode_limit: jnp.ndarray             # (B,) terminated-by-time-limit
+    task_completion_rate: jnp.ndarray      # (B,)
     task_completion_delay: jnp.ndarray     # (B,)
     epsilon: jnp.ndarray                   # ()
 
@@ -84,7 +92,12 @@ class ParallelRunner:
     # ------------------------------------------------------------------ state
 
     def init_state(self, key: jax.Array) -> RunnerState:
-        """Initial env states; norms start fresh (as at subprocess spawn)."""
+        """Initial env states; norms start fresh (as at subprocess spawn).
+        ``env_args.seed`` is folded into the key chain (Q8: the reference
+        hands worker ``i`` ``seed + i``; here one fold_in re-seeds the whole
+        per-lane split, so two configs differing only in env seed roll
+        different worlds)."""
+        key = jax.random.fold_in(key, self.cfg.env_args.seed)
         key, k_reset = jax.random.split(key)
         states, *_ = jax.vmap(self.env.reset)(
             jax.random.split(k_reset, self.batch_size))
@@ -156,16 +169,18 @@ class ParallelRunner:
             filled=jnp.ones((b, t_len), bool),
         )
 
+        last = lambda x: bt(x)[:, -1]      # terminal-step info values
         stats = RolloutStats(
             episode_return=bt(reward).sum(axis=1),
             episode_length=jnp.full((b,), t_len, jnp.float32),
-            delay_reward=bt(info.delay_reward).sum(axis=1),
-            overtime_penalty=bt(info.overtime_penalty).sum(axis=1),
-            channel_utilization_rate=bt(
-                info.channel_utilization_rate).sum(axis=1),
-            conflict_ratio=bt(info.conflict_ratio).sum(axis=1),
-            task_completion_rate=bt(info.task_completion_rate)[:, -1],
-            task_completion_delay=bt(info.task_completion_delay)[:, -1],
+            reward=last(reward),
+            delay_reward=last(info.delay_reward),
+            overtime_penalty=last(info.overtime_penalty),
+            channel_utilization_rate=last(info.channel_utilization_rate),
+            conflict_ratio=last(info.conflict_ratio),
+            episode_limit=last(info.episode_limit).astype(jnp.float32),
+            task_completion_rate=last(info.task_completion_rate),
+            task_completion_delay=last(info.task_completion_delay),
             epsilon=eps[-1],
         )
         new_rs = RunnerState(env_states=env_states, key=key, t_env=t_env)

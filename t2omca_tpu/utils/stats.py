@@ -1,0 +1,65 @@
+"""Terminal-info stat aggregation — the reference runner's contract.
+
+Re-creates ``cur_stats`` / ``cur_returns`` semantics of
+``/root/reference/parallel_runner.py:193-231`` exactly:
+
+* only the info dict of the TERMINAL step of each episode enters the stats
+  (the reference appends ``data["info"]`` to ``final_env_infos`` when an env
+  reports ``terminated``, ``:168-170``);
+* values are summed across envs AND across rollouts until a flush, with
+  ``n_episodes`` accumulating ``batch_size`` per rollout (``:226-228``);
+* a flush logs ``<k>_mean = Σv / n_episodes`` plus ``return_mean`` over the
+  accumulated per-episode returns, then clears (``:222-231``);
+* test stats flush only when exactly the rounded ``test_nepisode`` quota of
+  returns has accumulated (quirk Q10, ``:212-214``); train stats flush on the
+  ``runner_log_interval`` cadence with ``epsilon`` logged alongside
+  (``:215-219``).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import List
+
+import jax
+import numpy as np
+
+#: info keys present in the reference env's terminal-step info dict
+#: (``/root/reference/environment_multi_mec.py:343-364``)
+TERMINAL_INFO_KEYS = (
+    "reward", "delay_reward", "overtime_penalty",
+    "channel_utilization_rate", "conflict_ratio", "episode_limit",
+    "task_completion_rate", "task_completion_delay",
+)
+
+
+class StatsAccumulator:
+    """Accumulates RolloutStats across rollouts; flush = reference ``_log``."""
+
+    def __init__(self):
+        self.stats = defaultdict(float)
+        self.n_episodes = 0
+        self.returns: List[float] = []
+        self.epsilon = 0.0
+
+    def push(self, rollout_stats) -> None:
+        s = jax.device_get(rollout_stats)
+        ret = np.atleast_1d(np.asarray(s.episode_return))
+        self.returns.extend(float(x) for x in ret)
+        self.n_episodes += len(ret)
+        for k in TERMINAL_INFO_KEYS:
+            self.stats[k] += float(np.sum(getattr(s, k)))
+        self.epsilon = float(np.mean(np.asarray(s.epsilon)))
+
+    def flush(self, logger, t_env: int, prefix: str = "") -> None:
+        """Log ``return_mean`` + every ``<k>_mean`` and clear
+        (``/root/reference/parallel_runner.py:222-231``)."""
+        if self.returns:
+            logger.log_stat(prefix + "return_mean",
+                            float(np.mean(self.returns)), t_env)
+        n = max(self.n_episodes, 1)
+        for k, v in self.stats.items():
+            logger.log_stat(prefix + k + "_mean", v / n, t_env)
+        self.stats.clear()
+        self.returns.clear()
+        self.n_episodes = 0

@@ -1,0 +1,113 @@
+"""Metric-contract tests (SURVEY.md §5.5): terminal-info aggregation
+semantics of ``/root/reference/parallel_runner.py:168-170,202-231``."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,
+                               TrainConfig, sanity_check)
+from t2omca_tpu.controllers import BasicMAC
+from t2omca_tpu.envs.registry import make_env
+from t2omca_tpu.learners import QMixLearner
+from t2omca_tpu.runners import ParallelRunner
+from t2omca_tpu.utils.stats import TERMINAL_INFO_KEYS, StatsAccumulator
+
+
+class RecordingLogger:
+    def __init__(self):
+        self.logged = []
+
+    def log_stat(self, key, value, t):
+        self.logged.append((key, value, t))
+
+    def last(self, key):
+        vals = [v for k, v, _ in self.logged if k == key]
+        return vals[-1] if vals else None
+
+
+@dataclasses.dataclass
+class FakeStats:
+    episode_return: np.ndarray
+    epsilon: np.ndarray
+    reward: np.ndarray = None
+    delay_reward: np.ndarray = None
+    overtime_penalty: np.ndarray = None
+    channel_utilization_rate: np.ndarray = None
+    conflict_ratio: np.ndarray = None
+    episode_limit: np.ndarray = None
+    task_completion_rate: np.ndarray = None
+    task_completion_delay: np.ndarray = None
+
+    def __post_init__(self):
+        for k in TERMINAL_INFO_KEYS:
+            if getattr(self, k) is None:
+                setattr(self, k, np.zeros_like(self.episode_return))
+
+
+def test_accumulator_sums_terminal_infos_across_rollouts():
+    """<k>_mean = Σ(terminal infos over envs AND rollouts) / n_episodes."""
+    acc = StatsAccumulator()
+    s1 = FakeStats(episode_return=np.array([1.0, 3.0]),
+                   epsilon=np.array(0.5),
+                   reward=np.array([2.0, 4.0]),
+                   task_completion_rate=np.array([0.5, 0.7]))
+    s2 = FakeStats(episode_return=np.array([5.0, 7.0]),
+                   epsilon=np.array(0.4),
+                   reward=np.array([6.0, 8.0]),
+                   task_completion_rate=np.array([0.9, 0.9]))
+    acc.push(s1)
+    acc.push(s2)
+    assert acc.n_episodes == 4
+    log = RecordingLogger()
+    acc.flush(log, t_env=100)
+    assert log.last("return_mean") == np.mean([1, 3, 5, 7])
+    assert log.last("reward_mean") == (2 + 4 + 6 + 8) / 4
+    assert log.last("task_completion_rate_mean") == (0.5 + 0.7 + 0.9 + 0.9) / 4
+    # flush clears: a second flush logs nothing new for return_mean
+    n_before = len(log.logged)
+    acc.flush(log, t_env=200)
+    assert all(k != "return_mean" for k, _, t in log.logged[n_before:])
+    assert acc.n_episodes == 0
+
+
+def test_accumulator_epsilon_tracks_last_push():
+    acc = StatsAccumulator()
+    acc.push(FakeStats(episode_return=np.array([0.0]),
+                       epsilon=np.array(0.25)))
+    assert acc.epsilon == 0.25
+
+
+def test_rollout_stats_carry_terminal_step_values():
+    """RolloutStats info fields must be the TERMINAL step's info values,
+    not per-step sums (reference ``final_env_infos`` semantics)."""
+    cfg = sanity_check(TrainConfig(
+        batch_size_run=3,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=5),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1),
+        replay=ReplayConfig(buffer_size=4),
+    ))
+    env = make_env(cfg.env_args)
+    info = env.get_env_info()
+    mac = BasicMAC.build(cfg, info)
+    learner = QMixLearner.build(cfg, mac, info)
+    runner = ParallelRunner(env, mac, cfg)
+    ls = learner.init_state(jax.random.PRNGKey(0))
+    rs = runner.init_state(jax.random.PRNGKey(1))
+    rs, batch, stats = jax.jit(runner.run, static_argnames="test_mode")(
+        ls.params["agent"], rs, test_mode=False)
+
+    reward = np.asarray(batch.reward)                     # (B, T)
+    np.testing.assert_allclose(np.asarray(stats.episode_return),
+                               reward.sum(axis=1), rtol=1e-6)
+    # terminal-step semantics: stats.reward is the LAST slot's reward
+    np.testing.assert_allclose(np.asarray(stats.reward), reward[:, -1],
+                               rtol=1e-6)
+    # the env terminates only via the time limit -> episode_limit info = 1
+    np.testing.assert_allclose(np.asarray(stats.episode_limit), 1.0)
+    assert stats.task_completion_rate.shape == (3,)
+    assert float(np.asarray(stats.task_completion_rate).min()) >= 0.0
