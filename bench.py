@@ -94,6 +94,72 @@ def breakdown(cfg, exp, ts, _time, args) -> int:
     return 0
 
 
+def bench_train(cfg, _time, args) -> int:
+    """Learner-side throughput — the second half of the north-star metric
+    (BASELINE.json: "env-steps/sec/chip + mixer train-steps/sec").
+
+    Measures (a) ``train_iter``: PER sample → QMIX double-Q train step over
+    the full episode scan → priority feedback, as one jitted program
+    (reference hot loop /root/reference/per_run.py:224-238), and (b) one
+    interleaved driver iteration (rollout + insert + train), reported as
+    env-steps/s inclusive of training (config 4: PER + target-net sync)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from t2omca_tpu.run import Experiment
+
+    bs = 4 if args.smoke else 32
+    cfg = cfg.replace(
+        batch_size=bs,
+        replay=dataclasses.replace(cfg.replay, prioritized=True,
+                                   buffer_size=2 * cfg.batch_size_run))
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(0)
+    rollout, insert, train_iter = exp.jitted_programs()
+    b, t_len = cfg.batch_size_run, cfg.env_args.episode_limit
+
+    # fill the buffer with one rollout so PER has real priorities
+    rs, batch, _ = rollout(ts.learner.params["agent"], ts.runner,
+                           test_mode=False)
+    ts = ts.replace(runner=rs, buffer=insert(ts.buffer, batch),
+                    episode=jnp.asarray(b, jnp.int32))
+    key = jax.random.PRNGKey(7)
+
+    def one_train():
+        _, info = train_iter(ts, key, jnp.asarray(1000))
+        return info["loss"]
+
+    dt_train = _time(one_train)
+
+    def one_interleaved():
+        rs2, batch2, _ = rollout(ts.learner.params["agent"], ts.runner,
+                                 test_mode=False)
+        ts2 = ts.replace(runner=rs2, buffer=insert(ts.buffer, batch2))
+        _, info = train_iter(ts2, key, jnp.asarray(1000))
+        return info["loss"]
+
+    dt_full = _time(one_interleaved)
+
+    env_steps = b * t_len
+    print(f"# train_iter ({bs} episodes x {t_len + 1} slots, PER on): "
+          f"{dt_train * 1e3:.1f} ms -> {1.0 / dt_train:.2f} train-steps/s",
+          file=sys.stderr)
+    print(f"# interleaved rollout+insert+train: {dt_full * 1e3:.1f} ms -> "
+          f"{env_steps / dt_full:,.0f} env-steps/s incl. training",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "train_steps_per_sec",
+        "value": round(1.0 / dt_train, 2),
+        "unit": "train-steps/s/chip",
+        "interleaved_env_steps_per_sec": round(env_steps / dt_full, 1),
+        "train_batch_episodes": bs,
+        "vs_baseline": None,
+    }))
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
@@ -109,6 +175,10 @@ def main() -> int:
     ap.add_argument("--breakdown", action="store_true",
                     help="attribute the slot time: env-only rollout "
                          "(seq vs fast norm), acting-only scan, full rollout")
+    ap.add_argument("--train", action="store_true",
+                    help="benchmark the learner: train_iter (PER sample -> "
+                         "train -> priority update) and the interleaved "
+                         "rollout+train loop (BASELINE.json config 4)")
     args = ap.parse_args()
 
     if args.smoke:
@@ -153,11 +223,6 @@ def main() -> int:
             replay=ReplayConfig(buffer_size=4, store_dtype="bfloat16"),
         ))
 
-    exp = Experiment.build(cfg)
-    ts = exp.init_train_state(0)
-    rollout = jax.jit(exp.runner.run, static_argnames="test_mode")
-    params = ts.learner.params["agent"]
-
     import numpy as np
 
     def _sync(x):
@@ -175,6 +240,14 @@ def main() -> int:
             fn_times.append(time.perf_counter() - t0)
         fn_times.sort()
         return fn_times[len(fn_times) // 2]
+
+    if args.train:       # builds its own Experiment (PER-enabled replay)
+        return bench_train(cfg, _time, args)
+
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(0)
+    rollout = jax.jit(exp.runner.run, static_argnames="test_mode")
+    params = ts.learner.params["agent"]
 
     if args.breakdown:
         return breakdown(cfg, exp, ts, _time, args)

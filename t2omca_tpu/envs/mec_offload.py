@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from ..components.transforms import one_hot
 from ..config import EnvConfig
 from .critic import critic
 from .normalization import NormState, normalize, normalize_batch
@@ -209,8 +210,9 @@ class MultiAgvOffloadingEnv:
 
     @staticmethod
     def _ack_onehot(last_ack: jnp.ndarray) -> jnp.ndarray:
-        """ack_mapping {-1:[1,0,0], 0:[0,1,0], 1:[0,0,1]} (reference :7)."""
-        return jax.nn.one_hot(last_ack + 1, 3)
+        """ack_mapping {-1:[1,0,0], 0:[0,1,0], 1:[0,0,1]} (reference :7);
+        built with the M15 OneHot transform."""
+        return one_hot(last_ack + 1, 3)
 
     # ------------------------------------------------------------------ obs/state
 
@@ -263,8 +265,10 @@ class MultiAgvOffloadingEnv:
         inf = self._agent_inf(state)
         parts = [ack1h.reshape(-1), inf.reshape(-1)]
         if self.cfg.state_last_action:
-            la1h = jax.nn.one_hot(state.last_action, self.n_actions)
-            parts.insert(0, la1h.reshape(-1))
+            # M15 OneHot: the reference stores np.eye(n_actions)[actions]
+            # (:318) and would concat it here (:196)
+            parts.insert(0, one_hot(state.last_action,
+                                    self.n_actions).reshape(-1))
         return jnp.concatenate(parts)
 
     def get_avail_actions(self, state: EnvState) -> jnp.ndarray:

@@ -34,29 +34,44 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _online_block(q, k_blk, v_blk, o, l, m):
+def _online_block(q, k_blk, v_blk, o, l, m, mask_blk=None):
     """Accumulate one K/V block into the running (o, l, m) softmax state.
 
     q ``(..., Tq, D)``; k_blk/v_blk ``(..., Tk, D)``; o ``(..., Tq, D)``;
-    l, m ``(..., Tq)``.
+    l, m ``(..., Tq)``; ``mask_blk (..., Tk)`` marks valid key positions
+    (False keys — e.g. token-axis padding — are excluded from the softmax).
     """
     logits = jnp.einsum("...qd,...kd->...qk", q, k_blk)
+    if mask_blk is not None:
+        logits = jnp.where(mask_blk[..., None, :], logits, -jnp.inf)
     m_blk = logits.max(axis=-1)
     m_new = jnp.maximum(m, m_blk)
-    alpha = jnp.exp(m - m_new)                       # rescale old state
-    p = jnp.exp(logits - m_new[..., None])
+    # all-masked-so-far rows have m == m_new == -inf. Double-where: the
+    # inner where keeps exp's argument finite so the UNTAKEN branch never
+    # evaluates exp(-inf - -inf) = NaN — where's VJP differentiates both
+    # branches, so a single outer where still back-propagates NaN.
+    neg = m_new == -jnp.inf
+    alpha = jnp.where(neg, 0.0,
+                      jnp.exp(jnp.where(neg, 0.0, m - m_new)))
+    negq = neg[..., None]
+    p = jnp.where(negq, 0.0,
+                  jnp.exp(jnp.where(negq, 0.0, logits - m_new[..., None])))
     l_new = l * alpha + p.sum(axis=-1)
     o_new = o * alpha[..., None] + jnp.einsum("...qk,...kd->...qd", p, v_blk)
     return o_new, l_new, m_new
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   axis_name: str) -> jnp.ndarray:
+                   axis_name: str,
+                   kv_mask: jnp.ndarray | None = None) -> jnp.ndarray:
     """Exact softmax attention with the token axis sharded on ``axis_name``.
 
     Call inside ``shard_map``; per-device shapes ``(..., T_local, D)``.
     Returns the local block of the attention output. K/V travel the ring
-    once (N-1 ``ppermute`` hops over ICI), Q never moves.
+    once (N-1 ``ppermute`` hops over ICI), Q never moves. ``kv_mask``
+    (``(..., T_local)`` bool, sharded like K/V) excludes padded key
+    positions — needed when the global token count is not a multiple of the
+    axis size.
     """
     n = lax.psum(1, axis_name)
     perm = [(j, (j - 1) % n) for j in range(n)]      # pull from the right
@@ -67,17 +82,25 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     l = o[..., 0]
     m = l - jnp.inf
 
+    # one loop body for both paths: an absent mask becomes all-True (the
+    # extra ppermute of a bool block is negligible next to the K/V blocks,
+    # and a single body keeps the NaN guard in _online_block on one path)
+    if kv_mask is None:
+        kv_mask = jnp.broadcast_to(
+            (q.sum() * 0 == 0), k.shape[:-1])   # device-varying all-True
+
     def body(i, carry):
-        o, l, m, kb, vb = carry
+        o, l, m, kb, vb, mb = carry
         o, l, m = _online_block(q.astype(jnp.float32),
                                 kb.astype(jnp.float32),
-                                vb.astype(jnp.float32), o, l, m)
+                                vb.astype(jnp.float32), o, l, m, mb)
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
-        return o, l, m, kb, vb
+        mb = lax.ppermute(mb, axis_name, perm)
+        return o, l, m, kb, vb, mb
 
-    o, l, m, _, _ = lax.fori_loop(0, n, body, (o, l, m, k, v))
-    return (o / l[..., None]).astype(q.dtype)
+    o, l, m, *_ = lax.fori_loop(0, n, body, (o, l, m, k, v, kv_mask))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

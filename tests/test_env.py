@@ -348,3 +348,20 @@ def test_fast_norm_env_equivalence():
     # the two paths' outputs converge after warm-up
     assert np.mean(devs[-10:]) < np.mean(devs[:10])
     assert devs[-1] < 0.15, devs[-5:]
+
+
+def test_state_last_action_flag():
+    """state_last_action prepends per-agent action one-hots to the global
+    state (reference declares the flag at :11, concat slot at :196)."""
+    env = make_env(state_last_action=True)
+    base = make_env()
+    assert env.state_dim == base.state_dim + 4 * env.n_actions
+    assert env.state_entity_feats == base.state_entity_feats + env.n_actions
+
+    st, *_ = env.reset(KEY)
+    actions = jnp.asarray([0, 1, 2, 0])
+    avail = env.get_avail_actions(st)
+    actions = jnp.where(avail[jnp.arange(4), actions] > 0, actions, 0)
+    st2, _, _, _, _, gstate, _ = env.step(st, actions, jax.random.PRNGKey(1))
+    la = np.asarray(gstate[:4 * env.n_actions]).reshape(4, env.n_actions)
+    np.testing.assert_allclose(la, np.eye(env.n_actions)[np.asarray(actions)])
