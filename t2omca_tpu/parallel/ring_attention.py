@@ -86,8 +86,11 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # extra ppermute of a bool block is negligible next to the K/V blocks,
     # and a single body keeps the NaN guard in _online_block on one path)
     if kv_mask is None:
-        kv_mask = jnp.broadcast_to(
-            (q.sum() * 0 == 0), k.shape[:-1])   # device-varying all-True
+        # unconditionally-True mask derived from k so shard_map marks it
+        # device-varying; `| True` keeps it True even for non-finite k
+        # (a finiteness-dependent expression would silently drop a whole
+        # device's valid keys if one value overflowed)
+        kv_mask = (k[..., 0] * 0 == 0) | jnp.bool_(True)
 
     def body(i, carry):
         o, l, m, kb, vb, mb = carry

@@ -52,28 +52,32 @@ def _sp_transformer(tf_params, tokens, valid, *, heads: int, depth: int,
     of the token axis; ``valid (T_local,)`` marks real (non-pad) tokens.
     Mirrors ``models/transformer.py`` with keys pinned to layer-0 tokens."""
     b, t_loc, e = tokens.shape
+    dt = tokens.dtype                 # compute dtype (mixer.dtype, cast by caller)
     k0 = tokens                       # layer-0 key pinning
     kv_mask = jnp.broadcast_to(valid[None, None, :], (b, heads, t_loc))
     x = tokens
     scale = head_dim ** -0.25         # Q1: applied to queries AND keys
+    w = lambda p_: p_.astype(dt)
 
     for i in range(depth):
         bp = tf_params[f"block_{i}"]
         at = bp["attention"]
-        split = lambda z, w: (z @ w).reshape(b, t_loc, heads, head_dim
-                                             ).transpose(0, 2, 1, 3)
+        split = lambda z, wk: (z @ w(wk)).reshape(b, t_loc, heads, head_dim
+                                                  ).transpose(0, 2, 1, 3)
         q = split(x, at["toqueries"]["kernel"]) * scale
         k = split(k0, at["tokeys"]["kernel"]) * scale
         v = split(k0, at["tovalues"]["kernel"])
 
         ctx = ring_attention(q, k, v, axis, kv_mask)   # (B, H, T_loc, D)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, t_loc, heads * head_dim)
-        attended = ctx @ at["unifyheads"]["kernel"] + at["unifyheads"]["bias"]
+        attended = (ctx @ w(at["unifyheads"]["kernel"])
+                    + w(at["unifyheads"]["bias"]))
 
         # Q2: post-LN residuals; FFN is token-local
         x1 = _ln(attended + x, bp["norm1"]["scale"], bp["norm1"]["bias"])
-        ff = jnp.maximum(x1 @ bp["ff1"]["kernel"] + bp["ff1"]["bias"], 0.0)
-        ff = ff @ bp["ff2"]["kernel"] + bp["ff2"]["bias"]
+        ff = jnp.maximum(x1 @ w(bp["ff1"]["kernel"]) + w(bp["ff1"]["bias"]),
+                         0.0)
+        ff = ff @ w(bp["ff2"]["kernel"]) + w(bp["ff2"]["bias"])
         x = _ln(ff + x1, bp["norm2"]["scale"], bp["norm2"]["bias"])
     return x
 
@@ -95,11 +99,15 @@ def mixer_apply_sp(mixer: TransformerMixer, variables, qvals: jnp.ndarray,
     else:   # Q12: all agents' obs entities
         inputs = obs.reshape(b, mixer.n_agents * mixer.n_entities,
                              mixer.feat_dim)
+    # compute dtype mirrors the dense module (flax Dense/Transformer with
+    # dtype=mixer.dtype): bf16 perf mode keeps token activations and the
+    # ring's K/V traffic in bf16; LN statistics and the hypernet readout
+    # stay f32 either way
+    dt = mixer.dtype
     fe = p["feat_embedding"]
-    embs = inputs @ fe["kernel"] + fe["bias"]
+    embs = inputs.astype(dt) @ fe["kernel"].astype(dt) + fe["bias"].astype(dt)
     tokens = jnp.concatenate(
-        [embs, hidden_states.astype(embs.dtype),
-         hyper_weights.astype(embs.dtype)], axis=1)
+        [embs, hidden_states.astype(dt), hyper_weights.astype(dt)], axis=1)
     t = tokens.shape[1]
 
     # pad the token axis to a multiple of the axis size; padded keys are

@@ -1,0 +1,51 @@
+"""Learning-quality regression against the committed config-1 artifact.
+
+The north-star quality target (BASELINE.json) asks for evidence that the
+QMIX learner actually learns at the reference's config-1 scale point. A
+full-length (t_max=205k) run's metric stream is committed under
+``runs/config1_full/`` together with a measured random-policy baseline;
+these tests pin the claim so a learner change that silently breaks learning
+fails CI without re-running the 30-minute training.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+ROOT = os.path.join(os.path.dirname(__file__), "..", "runs", "config1_full")
+
+
+def _series(key):
+    paths = glob.glob(os.path.join(ROOT, "qmix*", "metrics.jsonl"))
+    if not paths:
+        pytest.skip("config1_full artifact not present")
+    rows = [json.loads(l) for l in open(paths[0])]
+    return [(r["t"], r["value"]) for r in rows if r["key"] == key]
+
+
+def test_final_test_return_beats_random_baseline():
+    with open(os.path.join(ROOT, "random_baseline.json")) as f:
+        base = json.load(f)
+    returns = _series("test_return_mean")
+    assert len(returns) >= 10
+    final = np.mean([v for _, v in returns[-3:]])
+    # > 2 sigma of the random-policy spread above its mean
+    assert final > base["random_return_mean"] + 2 * base["random_return_std"], (
+        final, base)
+
+
+def test_loss_decreased_by_an_order_of_magnitude():
+    losses = _series("loss")
+    assert len(losses) >= 10
+    first = np.mean([v for _, v in losses[:2]])
+    last = np.mean([v for _, v in losses[-2:]])
+    assert last < first / 10.0, (first, last)
+
+
+def test_conflicts_driven_down():
+    crs = _series("test_conflict_ratio_mean")
+    last = np.mean([v for _, v in crs[-3:]])
+    assert last < 0.1, crs[-3:]
