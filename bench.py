@@ -179,6 +179,11 @@ def main() -> int:
                     help="benchmark the learner: train_iter (PER sample -> "
                          "train -> priority update) and the interleaved "
                          "rollout+train loop (BASELINE.json config 4)")
+    ap.add_argument("--heads", type=int, default=4,
+                    help="agent/mixer head count (d256 standard heads: 4 -> "
+                         "head_dim 64, 2 -> head_dim 128 = full MXU lanes)")
+    ap.add_argument("--tile", type=int, default=16,
+                    help="Pallas kernel tile (sequences per grid step)")
     args = ap.parse_args()
 
     if args.smoke:
@@ -216,10 +221,12 @@ def main() -> int:
             env_args=EnvConfig(agv_num=64, mec_num=8, num_channels=8,
                                episode_limit=steps,
                                fast_norm=not args.no_fast_norm),
-            model=ModelConfig(emb=256, heads=4, depth=2, mixer_emb=256,
-                              mixer_heads=4, mixer_depth=2,
+            model=ModelConfig(emb=256, heads=args.heads, depth=2,
+                              mixer_emb=256, mixer_heads=args.heads,
+                              mixer_depth=2,
                               standard_heads=True, dtype="bfloat16",
-                              use_pallas=not args.no_pallas),
+                              use_pallas=not args.no_pallas,
+                              pallas_tile=args.tile),
             replay=ReplayConfig(buffer_size=4, store_dtype="bfloat16"),
         ))
 

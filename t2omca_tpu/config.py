@@ -71,6 +71,7 @@ class ModelConfig:
     standard_heads: bool = False          # perf mode: per-head dim = emb//heads (quirk Q1 off)
     dtype: str = "float32"                # compute dtype: float32 | bfloat16 (perf mode)
     use_pallas: bool = False              # fused-kernel acting path (rollout forwards)
+    pallas_tile: int = 16                 # sequences per kernel grid step (VMEM-bounded)
     # entity counts: filled from env info when 0
     n_entities_obs: int = 0
     n_entities_state: int = 0
@@ -122,11 +123,15 @@ class TrainConfig:
     profile_start: int = 0                # t_env at which to start the trace
     profile_iterations: int = 3           # driver iterations to capture
 
-    # component selection (registries, reference §5.6)
+    # component selection (registries, reference §5.6; agent/mixer families
+    # follow the parent PyMARL lineage's registry pattern — the released
+    # slice hardcodes the transformer pair)
     runner: str = "parallel"
     mac: str = "basic_mac"
     learner: str = "qmix_learner"
     env: str = "multi_agv_offloading"
+    agent: str = "transformer"            # transformer | rnn
+    mixer: str = "transformer"            # transformer | qmix_ff | vdn
 
     # learning hyperparameters (M8 spec — pinned from the PyMARL/TransfQMIX
     # lineage the reference forks; the learner itself is unreleased)
@@ -174,11 +179,32 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
         raise ValueError(
             "use_pallas supports only dropout=0 and non-noisy agents "
             "(the fused acting kernel has no dropout/noise path)")
-    if cfg.model.mixer_emb != cfg.model.emb:
+    # valid family names; mirrored from controllers.AGENT_REGISTRY /
+    # learners.MIXER_REGISTRY (config cannot import them — circular) and
+    # pinned by tests/test_model_families.py
+    _agents, _mixers = {"transformer", "rnn"}, {"transformer", "qmix_ff",
+                                                "vdn"}
+    if cfg.agent not in _agents:
+        raise ValueError(f"unknown agent '{cfg.agent}'; valid: "
+                         f"{sorted(_agents)}")
+    if cfg.mixer not in _mixers:
+        raise ValueError(f"unknown mixer '{cfg.mixer}'; valid: "
+                         f"{sorted(_mixers)}")
+    if cfg.model.use_pallas and cfg.agent != "transformer":
         raise ValueError(
-            "mixer_emb must equal emb: the mixer concatenates agent hidden "
-            "tokens (dim emb) with its own embeddings (dim mixer_emb) "
-            "(reference n_transf_mixer.py:69)."
+            "use_pallas is the fused transformer acting path; "
+            f"agent='{cfg.agent}' has no Pallas kernel")
+    if (cfg.model.dropout > 0.0 and cfg.agent != "transformer"
+            and cfg.mixer != "transformer"):
+        raise ValueError(
+            "dropout is implemented by the transformer families only; "
+            f"agent='{cfg.agent}' + mixer='{cfg.mixer}' would silently "
+            "ignore it")
+    if cfg.mixer == "transformer" and cfg.model.mixer_emb != cfg.model.emb:
+        raise ValueError(
+            "mixer_emb must equal emb: the transformer mixer concatenates "
+            "agent hidden tokens (dim emb) with its own embeddings (dim "
+            "mixer_emb) (reference n_transf_mixer.py:69)."
         )
     return cfg.replace(test_nepisode=tn)
 

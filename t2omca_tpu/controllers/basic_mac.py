@@ -25,6 +25,10 @@ from ..components.action_selectors import SELECTOR_REGISTRY
 from ..components.schedules import DecayThenFlatSchedule
 from ..config import TrainConfig
 from ..models.agent import TransformerAgent
+from ..models.rnn_agent import RNNAgent
+
+#: agent families (parent PyMARL lineage registry pattern, SURVEY.md §2.3 M7)
+AGENT_REGISTRY = {"transformer": TransformerAgent, "rnn": RNNAgent}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,28 +40,33 @@ class BasicMAC:
     emb: int
     use_pallas: bool = False    # fused-kernel acting path (ops/fast_agent)
     pallas_interpret: bool = False
+    pallas_tile: int = 16
 
     @classmethod
     def build(cls, cfg: TrainConfig, env_info: dict) -> "BasicMAC":
         n_agents = env_info["n_agents"]
         n_entities = cfg.model.n_entities_obs or env_info["n_entities"]
         feat = env_info.get("obs_entity_feats")
-        if feat is None:
-            # flat-obs mode: the whole obs vector is one entity token
+        if feat is None or cfg.agent == "rnn":
+            # flat-obs mode / flat-input agents: the whole obs vector is one
+            # entity token
             n_entities, feat = 1, env_info["obs_shape"]
         use_pallas = cfg.model.use_pallas
         if use_pallas:
-            if cfg.model.dropout != 0.0 or cfg.action_selector == "noisy-new":
+            if (cfg.model.dropout != 0.0
+                    or cfg.action_selector == "noisy-new"
+                    or cfg.agent != "transformer"):
                 # also enforced in config.sanity_check; kept for callers
                 # that build a MAC without going through load_config
                 raise ValueError(
-                    "use_pallas supports only dropout=0 and non-noisy agents")
+                    "use_pallas supports only the non-noisy transformer "
+                    "agent with dropout=0")
             backend = jax.default_backend()
             if backend not in ("tpu", "cpu"):
                 raise ValueError(
                     f"use_pallas requires a TPU (or CPU-interpret) backend; "
                     f"got '{backend}' — unset model.use_pallas")
-        agent = TransformerAgent(
+        agent = AGENT_REGISTRY[cfg.agent](
             n_agents=n_agents,
             n_entities=n_entities + 0,
             feat_dim=feat,
@@ -78,7 +87,8 @@ class BasicMAC:
         return cls(agent=agent, selector=selector, n_agents=n_agents,
                    n_actions=env_info["n_actions"], emb=cfg.model.emb,
                    use_pallas=use_pallas,
-                   pallas_interpret=jax.default_backend() == "cpu")
+                   pallas_interpret=jax.default_backend() == "cpu",
+                   pallas_tile=cfg.model.pallas_tile)
 
     # ------------------------------------------------------------------ state
 
@@ -118,7 +128,7 @@ class BasicMAC:
             n_entities=a.n_entities, feat_dim=a.feat_dim, emb=a.emb,
             heads=a.heads, depth=a.depth, n_actions=a.n_actions,
             standard_heads=a.standard_heads, dtype=a.dtype,
-            interpret=self.pallas_interpret)
+            interpret=self.pallas_interpret, tile=self.pallas_tile)
 
     def select_actions(self, params, obs: jnp.ndarray, avail: jnp.ndarray,
                        hidden: jnp.ndarray, key: jax.Array,

@@ -33,7 +33,13 @@ from flax import struct
 from ..components.episode_buffer import EpisodeBatch
 from ..config import TrainConfig
 from ..controllers.basic_mac import BasicMAC
+from ..models.ff_mixer import QMixFFMixer, VDNMixer
 from ..models.mixer import TransformerMixer
+
+#: mixer families (parent PyMARL lineage registry pattern); all share the
+#: TransformerMixer call signature so the learner scan is mixer-agnostic
+MIXER_REGISTRY = {"transformer": TransformerMixer, "qmix_ff": QMixFFMixer,
+                  "vdn": VDNMixer}
 
 
 @struct.dataclass
@@ -56,7 +62,7 @@ def _make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
 @dataclasses.dataclass(frozen=True)
 class QMixLearner:
     mac: BasicMAC
-    mixer: TransformerMixer
+    mixer: Any                  # any MIXER_REGISTRY family
     cfg: TrainConfig
     obs_dim: int
     state_dim: int
@@ -72,7 +78,7 @@ class QMixLearner:
             # Q12 fallback: mixer tokenizes all agents' obs entities
             feat = env_info["obs_entity_feats"]
             n_entities = env_info["n_entities"]
-        mixer = TransformerMixer(
+        mixer = MIXER_REGISTRY[cfg.mixer](
             n_agents=env_info["n_agents"],
             n_entities=n_entities,
             feat_dim=feat,

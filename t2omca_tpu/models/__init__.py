@@ -1,6 +1,8 @@
 from .agent import TransformerAgent
+from .ff_mixer import QMixFFMixer, VDNMixer
 from .mixer import TransformerMixer
 from .noisy import NoisyLinear
+from .rnn_agent import RNNAgent
 from .transformer import MultiHeadAttention, Transformer, TransformerBlock
 
 __all__ = [
@@ -9,5 +11,8 @@ __all__ = [
     "TransformerBlock",
     "TransformerAgent",
     "TransformerMixer",
+    "QMixFFMixer",
+    "VDNMixer",
+    "RNNAgent",
     "NoisyLinear",
 ]

@@ -1,0 +1,119 @@
+"""Feed-forward QMIX hypernetwork mixer and VDN — alternative mixer families.
+
+The reference's transformer mixer (C7) is the TransfQMIX variant of the
+classic QMIX mixer; the parent PyMARL lineage selects mixers from a registry
+(standard QMIX hypernet, VDN sum). These supply those families with the SAME
+call signature as ``TransformerMixer`` — ``(qvals, hidden_states,
+hyper_weights, states, obs) → (q_tot, hyper')`` — so the learner's recurrent
+scan is mixer-agnostic (non-recurrent mixers just thread the dummy hyper
+carry through unchanged).
+
+QMIX math (monotonic two-layer mixing, hypernetworks conditioned on the
+global state): ``q_tot = pos(w2(s)) · elu(pos(w1(s)) · q + b1(s)) + b2(s)``
+with the same ``pos_func`` options as the transformer mixer.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .mixer import qmix_pos_func
+from .transformer import orthogonal_or_default
+
+
+class QMixFFMixer(nn.Module):
+    """Standard QMIX: hypernet weights from MLPs over the flat state."""
+
+    n_agents: int
+    n_entities: int = 0       # unused; interface parity
+    feat_dim: int = 0
+    emb: int = 32             # mixing embed dim
+    heads: int = 1
+    depth: int = 1
+    ff_hidden_mult: int = 4
+    dropout: float = 0.0
+    qmix_pos_func: str = "abs"
+    qmix_pos_func_beta: float = 1.0
+    state_entity_mode: bool = True
+    standard_heads: bool = False
+    use_orthogonal: bool = False
+    dtype: jnp.dtype = jnp.float32
+    hypernet_layers: int = 2
+    hypernet_emb: int = 64
+
+    def pos_func(self, x: jax.Array) -> jax.Array:
+        return qmix_pos_func(x, self.qmix_pos_func, self.qmix_pos_func_beta)
+
+    @nn.compact
+    def __call__(self, qvals: jax.Array, hidden_states: jax.Array,
+                 hyper_weights: jax.Array, states: jax.Array,
+                 obs: jax.Array, deterministic: bool = True,
+                 ) -> Tuple[jax.Array, jax.Array]:
+        del hidden_states, deterministic
+        b = qvals.shape[0]
+        # LayerNorm the hypernet input: this env's global state is
+        # intentionally unnormalized (reference get_state leaves state_norm
+        # commented, :203) with O(1e4) feature magnitudes; the transformer
+        # mixer bounds it through its post-LN blocks, the MLP hypernet needs
+        # the same protection or the mixed Q explodes within episodes
+        s = nn.LayerNorm(name="state_norm", dtype=self.dtype)(
+            states.reshape(b, -1).astype(self.dtype))
+        init = orthogonal_or_default(self.use_orthogonal)
+
+        def hyper(name, out):
+            x = s
+            if self.hypernet_layers >= 2:
+                x = nn.relu(nn.Dense(self.hypernet_emb, name=f"{name}_h",
+                                     dtype=self.dtype, kernel_init=init)(x))
+            return nn.Dense(out, name=name, dtype=self.dtype,
+                            kernel_init=init)(x).astype(jnp.float32)
+
+        w1 = self.pos_func(hyper("hyper_w1", self.n_agents * self.emb)
+                           ).reshape(b, self.n_agents, self.emb)
+        b1 = hyper("hyper_b1", self.emb).reshape(b, 1, self.emb)
+        w2 = self.pos_func(hyper("hyper_w2", self.emb)
+                           ).reshape(b, self.emb, 1)
+        b2 = nn.relu(hyper("hyper_b2", 1)).reshape(b, 1, 1)
+
+        hidden = nn.elu(jnp.matmul(qvals.astype(jnp.float32), w1) + b1)
+        y = jnp.matmul(hidden, w2) + b2
+        return y, hyper_weights          # non-recurrent: carry unchanged
+
+    def initial_hyper(self, batch_size: int) -> jax.Array:
+        """Dummy recurrent carry so the learner scan is mixer-agnostic."""
+        return jnp.zeros((batch_size, 3, self.emb))
+
+
+class VDNMixer(nn.Module):
+    """Value decomposition by summation (VDN): ``q_tot = Σ_a q_a``."""
+
+    n_agents: int
+    n_entities: int = 0
+    feat_dim: int = 0
+    emb: int = 32
+    heads: int = 1
+    depth: int = 1
+    ff_hidden_mult: int = 4
+    dropout: float = 0.0
+    qmix_pos_func: str = "abs"
+    qmix_pos_func_beta: float = 1.0
+    state_entity_mode: bool = True
+    standard_heads: bool = False
+    use_orthogonal: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, qvals: jax.Array, hidden_states: jax.Array,
+                 hyper_weights: jax.Array, states: jax.Array,
+                 obs: jax.Array, deterministic: bool = True,
+                 ) -> Tuple[jax.Array, jax.Array]:
+        del hidden_states, states, obs, deterministic
+        return (qvals.astype(jnp.float32).sum(axis=-1, keepdims=True),
+                hyper_weights)
+
+    def initial_hyper(self, batch_size: int) -> jax.Array:
+        return jnp.zeros((batch_size, 3, self.emb))

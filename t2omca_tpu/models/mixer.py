@@ -33,6 +33,18 @@ from flax import linen as nn
 from .transformer import Transformer, orthogonal_or_default
 
 
+def qmix_pos_func(x: jax.Array, kind: str, beta: float = 1.0) -> jax.Array:
+    """Monotonicity transform for QMIX mixing weights
+    (``n_transf_mixer.py:95-103``); shared by every mixer family."""
+    if kind == "softplus":
+        return jax.nn.softplus(beta * x) / beta
+    if kind == "quadratic":
+        return 0.5 * x ** 2
+    if kind == "abs":
+        return jnp.abs(x)
+    return x
+
+
 class TransformerMixer(nn.Module):
     n_agents: int
     n_entities: int            # n_entities_state override, else n_entities
@@ -50,14 +62,7 @@ class TransformerMixer(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     def pos_func(self, x: jax.Array) -> jax.Array:
-        if self.qmix_pos_func == "softplus":
-            b = self.qmix_pos_func_beta
-            return jax.nn.softplus(b * x) / b
-        if self.qmix_pos_func == "quadratic":
-            return 0.5 * x ** 2
-        if self.qmix_pos_func == "abs":
-            return jnp.abs(x)
-        return x
+        return qmix_pos_func(x, self.qmix_pos_func, self.qmix_pos_func_beta)
 
     @nn.compact
     def __call__(self, qvals: jax.Array, hidden_states: jax.Array,

@@ -1,0 +1,64 @@
+"""GRU recurrent Q-agent — the PyMARL-lineage alternative agent family.
+
+The reference release ships only the transformer agent (C6), but it is a
+slice of a PyMARL-style framework whose controllers select the agent from a
+registry (SURVEY.md §2.3 M7: ``mac_REGISTRY`` builds the agent; the parent
+lineage's default is an RNN agent). This supplies that family TPU-natively:
+``obs → Dense+relu → GRUCell → Q head``, same functional interface as
+``TransformerAgent`` (fold agents into batch, explicit hidden carry), so the
+MAC/learner/runner stack is agent-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .transformer import orthogonal_or_default
+
+
+class RNNAgent(nn.Module):
+    n_agents: int
+    n_entities: int          # unused (flat input); kept for interface parity
+    feat_dim: int
+    emb: int                 # GRU hidden size (= mixer emb when the
+    #                          transformer mixer consumes the hidden tokens)
+    heads: int = 1           # unused; interface parity
+    depth: int = 1
+    n_actions: int = 3
+    ff_hidden_mult: int = 4
+    dropout: float = 0.0
+    noisy: bool = False
+    standard_heads: bool = False
+    use_orthogonal: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array, hidden_state: jax.Array,
+                 deterministic: bool = True) -> Tuple[jax.Array, jax.Array]:
+        b, a, obs_dim = inputs.shape
+        x = inputs.reshape(b * a, obs_dim).astype(self.dtype)
+        h = hidden_state.reshape(b * a, self.emb).astype(self.dtype)
+
+        init = orthogonal_or_default(self.use_orthogonal)
+        x = nn.relu(nn.Dense(self.emb, name="fc1", dtype=self.dtype,
+                             kernel_init=init)(x))
+        h_new, _ = nn.GRUCell(self.emb, name="rnn", dtype=self.dtype)(h, x)
+        h_new = h_new.astype(jnp.float32)
+
+        if self.noisy:
+            from .noisy import NoisyLinear
+            q = NoisyLinear(self.n_actions, name="q_basic")(
+                h_new, deterministic=deterministic)
+        else:
+            q = nn.Dense(self.n_actions, name="q_basic",
+                         kernel_init=init)(h_new)
+
+        return (q.astype(jnp.float32).reshape(b, a, self.n_actions),
+                h_new.reshape(b, a, self.emb))
+
+    def initial_hidden(self, batch_size: int) -> jax.Array:
+        return jnp.zeros((batch_size, self.n_agents, self.emb))

@@ -27,7 +27,8 @@ from .transformer_block import fused_transformer_block
 
 def fast_transformer_apply(tf_params: dict, tokens: jnp.ndarray,
                            heads: int, depth: int, head_dim: int,
-                           interpret: bool = False) -> jnp.ndarray:
+                           interpret: bool = False,
+                           tile: int = 16) -> jnp.ndarray:
     """Apply ``depth`` fused blocks; ``tokens (S, T, E)``. Keys stay pinned
     to the layer-0 input (``transformer.py:126,140`` tuple threading).
     The token axis is padded to a sublane multiple ONCE here so every
@@ -52,7 +53,7 @@ def fast_transformer_apply(tf_params: dict, tokens: jnp.ndarray,
             bp["ff2"]["kernel"], bp["ff2"]["bias"],
             bp["norm2"]["scale"], bp["norm2"]["bias"],
             heads=heads, head_dim=head_dim, interpret=interpret,
-            t_real=t)
+            t_real=t, tile=tile)
     return x[:, :t, :] if tp != t else x
 
 
@@ -62,7 +63,8 @@ def agent_forward_fast(variables: dict, inputs: jnp.ndarray,
                        heads: int, depth: int, n_actions: int,
                        standard_heads: bool = False,
                        dtype=jnp.float32,
-                       interpret: bool = False
+                       interpret: bool = False,
+                       tile: int = 16
                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Drop-in for ``TransformerAgent.apply`` (non-noisy, dropout=0):
     inputs ``(B, A, obs)``, hidden ``(B, A, emb)`` → (q, hidden')."""
@@ -79,7 +81,7 @@ def agent_forward_fast(variables: dict, inputs: jnp.ndarray,
     tokens = jnp.concatenate([h, embs], axis=1)
     head_dim = emb // heads if standard_heads else emb
     out = fast_transformer_apply(p["transformer"], tokens, heads, depth,
-                                 head_dim, interpret=interpret)
+                                 head_dim, interpret=interpret, tile=tile)
 
     h_new = out[:, 0, :].astype(jnp.float32)
     qb = p["q_basic"]

@@ -116,7 +116,8 @@ def fused_transformer_block(
         w2: jnp.ndarray, b2: jnp.ndarray,
         n2_scale: jnp.ndarray, n2_bias: jnp.ndarray,
         heads: int, head_dim: int,
-        interpret: bool = False, t_real: int | None = None) -> jnp.ndarray:
+        interpret: bool = False, t_real: int | None = None,
+        tile: int = 16) -> jnp.ndarray:
     """One transformer block over ``(S, T, E)`` sequences, fully fused.
 
     ``x_q``/``x_k`` are the query tokens and the (layer-0) key tokens.
@@ -125,13 +126,14 @@ def fused_transformer_block(
     ``interpret=True`` runs the kernel in the Pallas interpreter (CPU tests).
     ``t_real``: pass the true token count when the input is already padded
     to a sublane multiple (multi-layer callers pad once); the output then
-    stays padded.
+    stays padded. ``tile``: target sequences per grid step (more rows per
+    kernel invocation = wider matmuls + better pipelining, bounded by VMEM).
     """
     s, t, e = x_q.shape
     pre_padded = t_real is not None
     if t_real is None:
         t_real = t
-    g = _pick_tile(s)
+    g = _pick_tile(s, tile)
     cdt = x_q.dtype
     # pad the token axis to a sublane multiple: in-kernel (g, t, e) →
     # (g·t, e) reshapes are layout-trivial only when t is tile-aligned

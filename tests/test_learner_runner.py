@@ -110,14 +110,16 @@ def test_repeated_training_decreases_loss(setup):
     w = jnp.ones((cfg.batch_size_run,))
     train = jax.jit(learner.train)
     losses = []
-    for i in range(30):
+    for i in range(50):
         ls, tinfo = train(ls, batch, w, jnp.asarray(i), jnp.asarray(0))
         losses.append(float(tinfo["loss"]))
     # overfitting one fixed batch must drive the TD loss down substantially
     # (grad-norm clip at 10 keeps steps small, so the drop is steady, not
-    # instant)
+    # instant). 50 iterations: the env-seed fold_in (Q8 wiring) changed the
+    # fixture's rollout data and the old 30-step/0.3x pair became borderline
+    # on the new batch (0.36x) — same threshold, longer overfit.
     assert losses[-1] < 0.3 * losses[0], losses[::10]
-    assert losses[-1] < losses[15] < losses[0]
+    assert losses[-1] < losses[25] < losses[0]
 
 
 def test_target_network_hard_sync_at_interval(setup):

@@ -1,0 +1,110 @@
+"""Agent/mixer family registries: RNN agent, feed-forward QMIX hypernet
+mixer, VDN — the parent-lineage alternatives around the reference's
+transformer pair (SURVEY.md §2.3 M7/M8 registry contracts)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,
+                               TrainConfig, sanity_check)
+from t2omca_tpu.controllers import BasicMAC
+from t2omca_tpu.controllers.basic_mac import AGENT_REGISTRY
+from t2omca_tpu.envs.registry import make_env
+from t2omca_tpu.learners import QMixLearner
+from t2omca_tpu.learners.qmix_learner import MIXER_REGISTRY
+from t2omca_tpu.runners import ParallelRunner
+
+
+def build(agent="transformer", mixer="transformer"):
+    cfg = sanity_check(TrainConfig(
+        agent=agent, mixer=mixer,
+        batch_size_run=2, batch_size=2,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=5),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1),
+        replay=ReplayConfig(buffer_size=8),
+    ))
+    env = make_env(cfg.env_args)
+    info = env.get_env_info()
+    mac = BasicMAC.build(cfg, info)
+    learner = QMixLearner.build(cfg, mac, info)
+    runner = ParallelRunner(env, mac, cfg)
+    return cfg, info, mac, learner, runner
+
+
+def test_registries_expose_families():
+    assert set(AGENT_REGISTRY) == {"transformer", "rnn"}
+    assert set(MIXER_REGISTRY) == {"transformer", "qmix_ff", "vdn"}
+
+
+@pytest.mark.parametrize("agent,mixer", [
+    ("rnn", "qmix_ff"), ("rnn", "vdn"), ("transformer", "qmix_ff"),
+    ("rnn", "transformer"),
+])
+def test_family_combo_trains(agent, mixer):
+    cfg, info, mac, learner, runner = build(agent, mixer)
+    ls = learner.init_state(jax.random.PRNGKey(0))
+    rs = runner.init_state(jax.random.PRNGKey(1))
+    run = jax.jit(runner.run, static_argnames="test_mode")
+    rs, batch, stats = run(ls.params["agent"], rs, test_mode=False)
+    assert batch.actions.shape == (2, 5, 3)
+
+    w = jnp.ones((cfg.batch_size_run,))
+    train = jax.jit(learner.train)
+    losses = []
+    for i in range(12):
+        ls, tinfo = train(ls, batch, w, jnp.asarray(i), jnp.asarray(0))
+        losses.append(float(tinfo["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]          # overfits one fixed batch
+
+
+def test_vdn_is_exact_sum():
+    _, info, _, learner, _ = build("rnn", "vdn")
+    b, a = 2, info["n_agents"]
+    qvals = jnp.arange(b * a, dtype=jnp.float32).reshape(b, 1, a)
+    params = learner.mixer.init(
+        jax.random.PRNGKey(0), qvals, jnp.zeros((b, a, 8)),
+        learner.mixer.initial_hyper(b), jnp.zeros((b, info["state_shape"])),
+        jnp.zeros((b, a, info["obs_shape"])))
+    y, hyper = learner.mixer.apply(params, qvals, jnp.zeros((b, a, 8)),
+                                   learner.mixer.initial_hyper(b),
+                                   jnp.zeros((b, info["state_shape"])),
+                                   jnp.zeros((b, a, info["obs_shape"])))
+    np.testing.assert_allclose(np.asarray(y[..., 0]),
+                               np.asarray(qvals.sum(-1)))
+
+
+def test_ff_mixer_monotonic_in_agent_qs():
+    _, info, _, learner, _ = build("rnn", "qmix_ff")
+    b, a = 2, info["n_agents"]
+    key = jax.random.PRNGKey(3)
+    qvals = jax.random.normal(key, (b, 1, a))
+    state = jax.random.normal(key, (b, info["state_shape"]))
+    hid = jnp.zeros((b, a, 8))
+    hyper = learner.mixer.initial_hyper(b)
+    obs = jnp.zeros((b, a, info["obs_shape"]))
+    params = learner.mixer.init(key, qvals, hid, hyper, state, obs)
+
+    g = jax.grad(lambda qv: learner.mixer.apply(
+        params, qv, hid, hyper, state, obs)[0].sum())(qvals)
+    assert (np.asarray(g) >= 0).all()
+
+
+def test_pallas_rejected_for_rnn_agent():
+    with pytest.raises(ValueError, match="[Pp]allas"):
+        sanity_check(TrainConfig(agent="rnn",
+                                 model=ModelConfig(use_pallas=True)))
+
+
+def test_unknown_family_names_rejected():
+    with pytest.raises(ValueError, match="unknown agent"):
+        sanity_check(TrainConfig(agent="gru"))
+    with pytest.raises(ValueError, match="unknown mixer"):
+        sanity_check(TrainConfig(mixer="qmix"))
+    with pytest.raises(ValueError, match="dropout"):
+        sanity_check(TrainConfig(agent="rnn", mixer="vdn",
+                                 model=ModelConfig(dropout=0.1)))
