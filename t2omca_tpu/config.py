@@ -194,12 +194,12 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
         raise ValueError(
             "use_pallas is the fused transformer acting path; "
             f"agent='{cfg.agent}' has no Pallas kernel")
-    if (cfg.model.dropout > 0.0 and cfg.agent != "transformer"
-            and cfg.mixer != "transformer"):
+    if cfg.model.dropout > 0.0 and cfg.agent != "transformer":
+        # mixer families legitimately lack dropout (VDN has no layers);
+        # the agent is where configured dropout must actually apply
         raise ValueError(
-            "dropout is implemented by the transformer families only; "
-            f"agent='{cfg.agent}' + mixer='{cfg.mixer}' would silently "
-            "ignore it")
+            "dropout is implemented by the transformer agent only; "
+            f"agent='{cfg.agent}' would silently ignore it")
     if cfg.mixer == "transformer" and cfg.model.mixer_emb != cfg.model.emb:
         raise ValueError(
             "mixer_emb must equal emb: the transformer mixer concatenates "

@@ -77,7 +77,10 @@ class QMixFFMixer(nn.Module):
         b1 = hyper("hyper_b1", self.emb).reshape(b, 1, self.emb)
         w2 = self.pos_func(hyper("hyper_w2", self.emb)
                            ).reshape(b, self.emb, 1)
-        b2 = nn.relu(hyper("hyper_b2", 1)).reshape(b, 1, 1)
+        # V(s): unclamped (standard QMIX) — unlike the transformer mixer,
+        # whose relu'd b2 mirrors the reference (n_transf_mixer.py:82); a
+        # clamp here would zero the V-head gradient whenever V(s) < 0
+        b2 = hyper("hyper_b2", 1).reshape(b, 1, 1)
 
         hidden = nn.elu(jnp.matmul(qvals.astype(jnp.float32), w1) + b1)
         y = jnp.matmul(hidden, w2) + b2

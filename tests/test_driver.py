@@ -116,3 +116,20 @@ def test_host_buffer_branch_end_to_end(tmp_path):
     assert int(jax.device_get(ts.learner.train_steps)) > 0
     keys, _ = logged_keys(tmp_path)
     assert "loss" in keys
+
+
+def test_evaluate_path_exports_replay_and_benchmark(tmp_path):
+    """evaluate_sequential end-to-end: greedy episodes on the episode
+    runner with replay (npz) + benchmark CSV export (reference
+    evaluate_sequential, per_run.py:74-101)."""
+    cfg = tiny_cfg(tmp_path, evaluate=True, save_replay=True,
+                   benchmark_mode=True, test_nepisode=2,
+                   animation_interval_evaluation=2)
+    ts = run(cfg, Logger())
+    replays = glob.glob(os.path.join(tmp_path, "*", "replay_episode_*.npz"))
+    # animation_interval_evaluation=2 -> episodes 0 (and 2, 4, ...) only
+    assert len(replays) == 1, replays
+    csvs = glob.glob(os.path.join(tmp_path, "*", "benchmark.csv"))
+    assert csvs, "benchmark CSV missing"
+    data = np.load(replays[0])
+    assert "pos" in data and data["pos"].shape[0] == cfg.env_args.episode_limit
