@@ -30,6 +30,11 @@ def main(argv=None) -> int:
                         help="key=value config overrides")
     args = parser.parse_args(argv)
 
+    # multi-host (DCN) leg: no-op unless a coordinator topology is
+    # configured in the environment (parallel/distributed.py)
+    from .parallel import maybe_initialize_distributed
+    maybe_initialize_distributed()
+
     cfg = load_config(args.config, tuple(args.overrides))
     if args.command in ("evaluate", "benchmark"):
         cfg = cfg.replace(evaluate=True)

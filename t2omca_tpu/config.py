@@ -194,12 +194,16 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
         raise ValueError(
             "use_pallas is the fused transformer acting path; "
             f"agent='{cfg.agent}' has no Pallas kernel")
-    if cfg.model.dropout > 0.0 and cfg.agent != "transformer":
-        # mixer families legitimately lack dropout (VDN has no layers);
-        # the agent is where configured dropout must actually apply
+    if (cfg.model.dropout > 0.0 and cfg.agent != "transformer"
+            and cfg.mixer != "transformer"):
+        # transformer modules implement dropout; with neither family
+        # selected a configured rate would be a silent no-op. (A transformer
+        # mixer alone still applies it in the mixer blocks, so rnn agent +
+        # transformer mixer stays valid.)
         raise ValueError(
-            "dropout is implemented by the transformer agent only; "
-            f"agent='{cfg.agent}' would silently ignore it")
+            "dropout is only implemented by the transformer families; "
+            f"agent='{cfg.agent}' + mixer='{cfg.mixer}' configures no "
+            "module that would apply it")
     if cfg.mixer == "transformer" and cfg.model.mixer_emb != cfg.model.emb:
         raise ValueError(
             "mixer_emb must equal emb: the transformer mixer concatenates "

@@ -1,3 +1,5 @@
+from .distributed import maybe_initialize_distributed
 from .mesh import (DataParallel, make_mesh, replicate, shard_episode_axis)
 
-__all__ = ["make_mesh", "replicate", "shard_episode_axis", "DataParallel"]
+__all__ = ["make_mesh", "replicate", "shard_episode_axis", "DataParallel",
+           "maybe_initialize_distributed"]

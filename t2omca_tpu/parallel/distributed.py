@@ -1,0 +1,60 @@
+"""Multi-host initialization — the DCN leg of the communication backend.
+
+The reference's entire "distributed backend" is a per-host subprocess farm
+over ``multiprocessing.Pipe`` (``/root/reference/parallel_runner.py:21-32,
+234-273``, SURVEY.md §5.8); it has no cross-host story at all. Here the
+cross-chip path is XLA collectives over ICI (``parallel/mesh.py``), and this
+module supplies the cross-HOST leg: one ``jax.distributed.initialize`` call
+makes ``jax.devices()`` span every host, after which ``make_mesh`` lays the
+data axis across hosts and NOTHING else changes — GSPMD routes collectives
+ICI-first, DCN only across host boundaries.
+
+Environment contract (standard JAX multi-process convention): the
+coordinator address and process topology come either from explicit arguments
+or from the scheduler environment (``JAX_COORDINATOR_ADDRESS``,
+``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID`` — or the TPU pod metadata, which
+``jax.distributed.initialize()`` resolves automatically on Cloud TPU).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def maybe_initialize_distributed(
+        coordinator_address: Optional[str] = None,
+        num_processes: Optional[int] = None,
+        process_id: Optional[int] = None) -> bool:
+    """Initialize the multi-host runtime when a topology is configured.
+
+    Returns True when ``jax.distributed.initialize`` ran (or had already
+    run), False when no multi-host topology is configured — single-host
+    runs are unaffected. Idempotent: a second call is a no-op.
+    """
+    addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = num_processes if num_processes is not None else int(
+        os.environ.get("JAX_NUM_PROCESSES", "0") or 0)
+    pid = process_id if process_id is not None else int(
+        os.environ.get("JAX_PROCESS_ID", "-1") or -1)
+
+    if not addr and nproc <= 1:
+        return False
+    kwargs = {}
+    if addr:
+        kwargs["coordinator_address"] = addr
+    if nproc > 0:
+        kwargs["num_processes"] = nproc
+    if pid >= 0:
+        kwargs["process_id"] = pid
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        # idempotency via the runtime's own double-init error (there is no
+        # public already-initialized predicate to query)
+        if "already" in str(e).lower():
+            return True
+        raise
+    return True

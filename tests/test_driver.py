@@ -122,6 +122,7 @@ def test_evaluate_path_exports_replay_and_benchmark(tmp_path):
     """evaluate_sequential end-to-end: greedy episodes on the episode
     runner with replay (npz) + benchmark CSV export (reference
     evaluate_sequential, per_run.py:74-101)."""
+    pytest.importorskip("pandas")   # benchmark_csv is gated on pandas
     cfg = tiny_cfg(tmp_path, evaluate=True, save_replay=True,
                    benchmark_mode=True, test_nepisode=2,
                    animation_interval_evaluation=2)

@@ -158,7 +158,7 @@ def test_target_mixer_unrolls_from_episode_start(setup):
     term = jnp.swapaxes(batch.terminated, 0, 1).astype(jnp.float32)
     mask = jnp.swapaxes(batch.filled, 0, 1).astype(jnp.float32)
 
-    qs, _ = learner._unroll_agent(ls.params["agent"], obs)
+    qs, hs = learner._unroll_agent(ls.params["agent"], obs)
     tqs, ths = learner._unroll_agent(ls.target_params["agent"], obs)
     best = jnp.argmax(jnp.where(avail > 0, qs, -jnp.inf), axis=-1)
     tmax = jnp.take_along_axis(tqs, best[..., None], axis=-1)[..., 0]
@@ -166,8 +166,20 @@ def test_target_mixer_unrolls_from_episode_start(setup):
     t_qtot = learner._unroll_mixer(ls.target_params["mixer"], tmax, ths,
                                    state, obs)[1:]
     targets = reward + cfg.gamma * (1.0 - term) * t_qtot
-    expect = float((targets * mask).sum() / jnp.maximum(mask.sum(), 1.0))
+    denom = jnp.maximum(mask.sum(), 1.0)
+    expect = float((targets * mask).sum() / denom)
     assert np.isclose(float(linfo["target_mean"]), expect, rtol=1e-5)
+
+    # full-loss oracle: SEPARATE unrolls here must reproduce the learner's
+    # fused/stacked online+target scan bit-for-bit (pure batching claim)
+    actions = jnp.swapaxes(batch.actions, 0, 1)
+    chosen = jnp.take_along_axis(qs[:-1], actions[..., None],
+                                 axis=-1)[..., 0]
+    q_tot = learner._unroll_mixer(ls.params["mixer"], chosen, hs[:-1],
+                                  state[:-1], obs[:-1])
+    td = (q_tot - targets) * mask
+    loss_expect = float((w[None, :] * td ** 2).sum() / denom)
+    assert np.isclose(float(linfo["loss"]), loss_expect, rtol=1e-5)
 
 
 @pytest.fixture(scope="module")

@@ -86,3 +86,13 @@ def test_dp_matches_single_device_loss(dp_setup):
         ls_local, batch_local, w, jnp.asarray(0), jnp.asarray(0))
     np.testing.assert_allclose(float(info_dp["loss"]),
                                float(info_local["loss"]), rtol=2e-4)
+
+
+def test_maybe_initialize_distributed_noop_single_host(monkeypatch):
+    """Without a coordinator topology the helper must not touch the
+    runtime (single-host runs unaffected)."""
+    from t2omca_tpu.parallel import maybe_initialize_distributed
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    assert maybe_initialize_distributed() is False
