@@ -355,11 +355,23 @@ class MultiAgvOffloadingEnv:
         # age all remaining jobs by one slot; drop expired (deadline <= 0)
         deadline = deadline - self.t_length
         keep = valid & (deadline > 0)
-        # compact: stable sort invalid-last keeps FIFO order of survivors
-        order = jnp.argsort(~keep, axis=1, stable=True)
-        data = jnp.take_along_axis(data, order, axis=1)
-        deadline = jnp.take_along_axis(deadline, order, axis=1)
-        valid = jnp.take_along_axis(keep, order, axis=1)
+        # compact survivors to the front in FIFO order: destination slot =
+        # exclusive prefix count of kept jobs (cumsum is monotone over the
+        # source order, so stability is free), realized as a one-hot gather
+        # matmul — cheaper on TPU than a stable argsort's sorting network
+        dest = jnp.cumsum(keep, axis=1) - 1                   # (A, J)
+        j = self.max_jobs
+        gather = (jnp.where(keep, dest, -1)[:, :, None]
+                  == jnp.arange(j)[None, None, :])            # (A, Jsrc, Jdst)
+        # HIGHEST precision: the default TPU matmul runs the MXU in bf16,
+        # which would lossily round job payload sizes every step — the
+        # compaction must stay an exact permutation like the take_along_axis
+        # it replaces
+        gf = gather.astype(jnp.float32)
+        hp = jax.lax.Precision.HIGHEST
+        data = jnp.einsum("aj,ajd->ad", data, gf, precision=hp)
+        deadline = jnp.einsum("aj,ajd->ad", deadline, gf, precision=hp)
+        valid = gather.any(axis=1)
 
         state = state.replace(mec_index=new_mec, pos=new_pos, job_data=data,
                               job_deadline=deadline, job_valid=valid)
@@ -450,9 +462,15 @@ class MultiAgvOffloadingEnv:
         both into one call."""
         actions = actions.astype(jnp.int32)
 
-        # per-MEC collision resolution (reference :319-326; Q14)
-        counts = jnp.zeros((self.n_mec, self.n_actions), jnp.int32)
-        counts = counts.at[state.mec_index, actions].add(1)
+        # per-MEC collision resolution (reference :319-326; Q14). The
+        # (mec, action) histogram is a one-hot einsum rather than a
+        # scatter-add: one MXU matmul instead of A serialized scatter
+        # updates per env; f32 accumulation is exact for counts < 2^24.
+        mec1h = one_hot(state.mec_index, self.n_mec)          # (A, M)
+        act1h = one_hot(actions, self.n_actions)              # (A, C)
+        counts = jnp.einsum("am,ac->mc", mec1h, act1h,
+                            precision=jax.lax.Precision.HIGHEST
+                            ).astype(jnp.int32)
         masked = jnp.where(counts > 1, 0, counts)
         # utilization sums ALL slots incl. action-0 (reference :327-329 quirk)
         utilization = masked.sum() / (self.cfg.num_channels * self.n_mec)

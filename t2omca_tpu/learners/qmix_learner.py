@@ -209,29 +209,18 @@ class QMixLearner:
 
         if key is not None:
             k_ag, k_tag, k_mx, k_tmx = jax.random.split(key, 4)
-            qs, hs = self._unroll_agent(params["agent"], obs, k_ag)
-            target_qs, target_hs = self._unroll_agent(
-                target_params["agent"], obs, k_tag)
         else:
-            k_mx = k_tmx = None
-            # online + target networks see the SAME observation sequence, so
-            # both unrolls fuse into one scan over params stacked on a
-            # leading axis: half the sequential scan programs, double the
-            # matmul batch per step (MXU-friendlier). Numerically identical
-            # to two separate unrolls (pure batching).
-            stacked = jax.tree.map(lambda a, b: jnp.stack([a, b]),
-                                   params["agent"], target_params["agent"])
-            b = obs.shape[1]
+            k_ag = k_tag = k_mx = k_tmx = None
 
-            def body(h2, obs_t):
-                q2, h2 = jax.vmap(
-                    lambda p, h: self.mac.forward(p, obs_t, h))(stacked, h2)
-                return h2, (q2, h2)
-
-            h0 = jnp.stack([self.mac.init_hidden(b)] * 2)
-            _, (q2s, h2s) = jax.lax.scan(body, h0, obs)
-            qs, target_qs = q2s[:, 0], q2s[:, 1]
-            hs, target_hs = h2s[:, 0], h2s[:, 1]
+        # the two unrolls stay SEPARATE deliberately: the target unroll
+        # feeds only stop_gradient-terminated consumers, so partial eval
+        # prunes its backward pass and saves no residuals for it — fusing
+        # both into one stacked scan would re-attach the target lane to the
+        # VJP (zero cotangents still cost full backward matmuls + 2x scan
+        # residual memory), trading a halved forward for a heavier backward
+        qs, hs = self._unroll_agent(params["agent"], obs, k_ag)
+        target_qs, target_hs = self._unroll_agent(
+            target_params["agent"], obs, k_tag)
 
         chosen = jnp.take_along_axis(
             qs[:-1], actions[..., None], axis=-1)[..., 0]  # (T, B, A)

@@ -12,8 +12,10 @@ ICI-first, DCN only across host boundaries.
 Environment contract (standard JAX multi-process convention): the
 coordinator address and process topology come either from explicit arguments
 or from the scheduler environment (``JAX_COORDINATOR_ADDRESS``,
-``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID`` — or the TPU pod metadata, which
-``jax.distributed.initialize()`` resolves automatically on Cloud TPU).
+``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``). On a Cloud TPU pod, where
+``jax.distributed.initialize()`` resolves the topology from pod metadata
+without any of those variables, set ``T2OMCA_MULTIHOST=1`` to opt in — an
+unconditional auto-detect would be wrong for the common single-host case.
 """
 
 from __future__ import annotations
@@ -40,7 +42,8 @@ def maybe_initialize_distributed(
     pid = process_id if process_id is not None else int(
         os.environ.get("JAX_PROCESS_ID", "-1") or -1)
 
-    if not addr and nproc <= 1:
+    pod_auto = os.environ.get("T2OMCA_MULTIHOST") == "1"
+    if not addr and nproc <= 1 and not pod_auto:
         return False
     kwargs = {}
     if addr:
