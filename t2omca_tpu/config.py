@@ -72,6 +72,11 @@ class ModelConfig:
     dtype: str = "float32"                # compute dtype: float32 | bfloat16 (perf mode)
     use_pallas: bool = False              # fused-kernel acting path (rollout forwards)
     pallas_tile: int = 16                 # sequences per kernel grid step (VMEM-bounded)
+    # exact token-0-only agent forward (ops/query_slice.py): on by default,
+    # auto-disabled where inapplicable (non-transformer agent, dropout>0,
+    # noisy selector); an explicit use_pallas=True takes precedence on the
+    # acting path
+    use_qslice: bool = True
     # entity counts: filled from env info when 0
     n_entities_obs: int = 0
     n_entities_state: int = 0

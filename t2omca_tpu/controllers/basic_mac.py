@@ -41,6 +41,7 @@ class BasicMAC:
     use_pallas: bool = False    # fused-kernel acting path (ops/fast_agent)
     pallas_interpret: bool = False
     pallas_tile: int = 16
+    use_qslice: bool = False    # exact token-0-only forward (ops/query_slice)
 
     @classmethod
     def build(cls, cfg: TrainConfig, env_info: dict) -> "BasicMAC":
@@ -84,11 +85,19 @@ class BasicMAC:
         schedule = DecayThenFlatSchedule(
             cfg.epsilon_start, cfg.epsilon_finish, cfg.epsilon_anneal_time)
         selector = SELECTOR_REGISTRY[cfg.action_selector](schedule)
+        # query-slice eligibility: exact only for the deterministic
+        # transformer path (no dropout to sample, no NoisyLinear q-head);
+        # an explicit use_pallas request keeps the kernel path
+        use_qslice = (cfg.model.use_qslice and not use_pallas
+                      and cfg.agent == "transformer"
+                      and cfg.model.dropout == 0.0
+                      and cfg.action_selector != "noisy-new")
         return cls(agent=agent, selector=selector, n_agents=n_agents,
                    n_actions=env_info["n_actions"], emb=cfg.model.emb,
                    use_pallas=use_pallas,
                    pallas_interpret=jax.default_backend() == "cpu",
-                   pallas_tile=cfg.model.pallas_tile)
+                   pallas_tile=cfg.model.pallas_tile,
+                   use_qslice=use_qslice)
 
     # ------------------------------------------------------------------ state
 
@@ -130,6 +139,34 @@ class BasicMAC:
             standard_heads=a.standard_heads, dtype=a.dtype,
             interpret=self.pallas_interpret, tile=self.pallas_tile)
 
+    def forward_qslice(self, params, obs: jnp.ndarray, hidden: jnp.ndarray
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Exact token-0-only forward over the same param tree
+        (ops/query_slice). Plain jnp, differentiable — also used by the
+        learner's deterministic unrolls. ``params`` may be the raw tree or
+        a ``prepare_acting_params`` result."""
+        from ..ops.query_slice import agent_forward_qslice
+        a = self.agent
+        return agent_forward_qslice(
+            params, obs, hidden,
+            n_entities=a.n_entities, feat_dim=a.feat_dim, emb=a.emb,
+            heads=a.heads, depth=a.depth, n_actions=a.n_actions,
+            standard_heads=a.standard_heads, dtype=a.dtype)
+
+    def prepare_acting_params(self, params):
+        """Pre-fold the qslice projection products ONCE, outside any scan
+        that calls ``select_actions``/``forward_qslice`` in its body (the
+        fold is loop-invariant; XLA is not guaranteed to hoist it). No-op
+        on the dense/pallas paths."""
+        if not self.use_qslice:
+            return params
+        from ..ops.query_slice import fold_agent_params
+        a = self.agent
+        return fold_agent_params(params, emb=a.emb, heads=a.heads,
+                                 depth=a.depth,
+                                 standard_heads=a.standard_heads,
+                                 dtype=a.dtype)
+
     def select_actions(self, params, obs: jnp.ndarray, avail: jnp.ndarray,
                        hidden: jnp.ndarray, key: jax.Array,
                        t_env: jnp.ndarray, test_mode: bool = False
@@ -139,6 +176,8 @@ class BasicMAC:
         k_noise, k_sel = jax.random.split(key)
         if self.use_pallas:
             q, hidden = self.forward_fast(params, obs, hidden)
+        elif self.use_qslice:
+            q, hidden = self.forward_qslice(params, obs, hidden)
         else:
             q, hidden = self.forward(params, obs, hidden, key=k_noise,
                                      deterministic=test_mode)

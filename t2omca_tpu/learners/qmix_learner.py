@@ -124,6 +124,25 @@ class QMixLearner:
     # ------------------------------------------------------------------ unrolls
 
     @property
+    def _agent_qslice(self) -> bool:
+        """Query-slice agent unroll eligibility for the LEARNER: unlike
+        ``mac.use_qslice`` this ignores ``use_pallas`` — the Pallas kernel
+        owns only the acting path (it has no VJP), so a pallas config still
+        trains on the exact differentiable qslice forward."""
+        return (self.cfg.model.use_qslice
+                and self.cfg.agent == "transformer"
+                and self.cfg.model.dropout == 0.0
+                and self.cfg.action_selector != "noisy-new")
+
+    @property
+    def _mixer_qslice(self) -> bool:
+        """Row-sliced mixer forward (ops/query_slice): exact for the
+        deterministic transformer mixer — only the last ``n_agents+3``
+        output rows are consumed (models/mixer.py:96-109)."""
+        return (self.cfg.model.use_qslice and self.cfg.mixer == "transformer"
+                and self.cfg.model.dropout == 0.0)
+
+    @property
     def needs_rngs(self) -> bool:
         """True when training must sample noise/dropout masks: NoisyNet
         sigma params only receive gradient if noise is drawn during the
@@ -142,8 +161,23 @@ class QMixLearner:
         b = obs_tm.shape[1]
 
         if key is None:
+            # the query-slice forward is the same function up to float
+            # reassociation (forward+gradient equivalence pinned in
+            # tests/test_qslice.py), so the deterministic unroll uses it
+            # whenever eligible; the weight fold happens here, outside the
+            # scan (differentiable, loop-invariant)
+            if self._agent_qslice:
+                from ..ops.query_slice import fold_agent_params
+                a = self.mac.agent
+                agent_params = fold_agent_params(
+                    agent_params, emb=a.emb, heads=a.heads, depth=a.depth,
+                    standard_heads=a.standard_heads, dtype=a.dtype)
+                fwd = self.mac.forward_qslice
+            else:
+                fwd = self.mac.forward
+
             def body(h, obs_t):
-                q, h = self.mac.forward(agent_params, obs_t, h)
+                q, h = fwd(agent_params, obs_t, h)
                 return h, (q, h)
 
             _, (qs, hs) = jax.lax.scan(body, self.mac.init_hidden(b), obs_tm)
@@ -168,10 +202,18 @@ class QMixLearner:
         b = q_tm.shape[1]
 
         if key is None:
+            if self._mixer_qslice:
+                from ..ops.query_slice import make_mixer_qslice
+                fold, mix = make_mixer_qslice(self.mixer)
+                # fold once, outside the scan (differentiable)
+                mixer_params = fold(mixer_params)
+            else:
+                mix = self.mixer.apply
+
             def body(hyper, xs):
                 qv, h, s, o = xs
-                q_tot, hyper = self.mixer.apply(
-                    mixer_params, qv[:, None, :], h, hyper, s, o)
+                q_tot, hyper = mix(mixer_params, qv[:, None, :], h, hyper,
+                                   s, o)
                 return hyper, q_tot[:, 0, 0]
 
             _, q_tots = jax.lax.scan(

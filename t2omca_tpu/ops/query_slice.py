@@ -1,0 +1,310 @@
+"""Query-slice agent forward: compute ONLY the hidden token's row.
+
+An exact algebraic reduction of ``TransformerAgent.__call__``, exploiting two
+structural facts of the reference architecture (both pinned by parity tests):
+
+1. **Keys are layer-0-pinned.** Every block attends its evolving queries
+   against the ORIGINAL embedded tokens — blocks return ``k`` unchanged
+   (``/root/reference/transformer.py:126,140``; ``models/transformer.py``
+   "Key threading"). So token ``i``'s output at depth ``L`` depends only on
+   token ``i``'s own query path and the shared layer-0 keys: information
+   never flows token→token→token across layers.
+2. **Only token 0 is consumed.** The agent reads ``out[:, 0]`` as the new
+   hidden state and Q-head input (``/root/reference/transf_agent.py:71``);
+   the other ``n_entities`` output rows are dead.
+
+Therefore the attention-output / unify / LayerNorm / FFN work for every
+entity token is dead computation — ~``(T-1)/T`` ≈ 98% of block FLOPs at the
+64-agent scale. This path carries a single query row (token 0) through the
+stack and contracts the key/value projections away entirely:
+
+* ``logits_h = (q_h·s)·(k0 Wk_h·s)^T = x0 (Wq_h Wk_h^T s^2) k0^T`` — fold
+  ``Wqk_h = Wq_h Wk_h^T s^2`` (E×E per head, computed once from the weights,
+  O(params) not O(tokens)), so keys are never materialized.
+* ``attended = Σ_h softmax(logits_h) (k0 Wv_h) Wu_h = Σ_h (attn_h k0) Wvu_h``
+  with ``Wvu_h = Wv_h Wu_h`` — values are never materialized either.
+
+Per sequence the block cost drops from O(T·E²·ff) to O(E²·ff + H·T·E): at the
+north-star scale (T=65, E=256) a ~50× FLOP reduction with bit-compatible
+semantics (float reassociation only; equivalence pinned to the flax module in
+``tests/test_qslice.py``, including gradients — the reduction is exact, so
+the learner can unroll through it too).
+
+All ops are fat ``(S, ·)×(·, ·)`` matmuls over the folded batch×agent axis
+plus two bandwidth-bound batched contractions against ``k0`` — no Pallas
+needed; XLA fuses the rest. Matches the numerics conventions of the fused
+kernel (``ops/transformer_block.py``): f32 accumulation, f32 LayerNorm
+statistics, softmax in f32 for the f32 parity mode and bf16 for the perf
+mode (mirroring ``models/transformer.py:101-105``).
+
+Forward-compatible with gradient flow: everything here is plain jnp, so
+``jax.grad`` through it yields the same gradients as the dense module (same
+function, different association).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+LN_EPS = 1e-6   # flax nn.LayerNorm default, as in ops/transformer_block.py
+
+
+def _ln(x32: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray
+        ) -> jnp.ndarray:
+    """f32 fast-variance LayerNorm over the last axis (flax-compatible)."""
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.maximum(
+        jnp.mean(x32 * x32, axis=-1, keepdims=True) - mean * mean, 0.0)
+    inv = jax.lax.rsqrt(var + LN_EPS)
+    return (x32 - mean) * inv * scale + bias
+
+
+#: marker key of a pre-folded parameter tree (see ``fold_transformer``)
+FOLDED = "__qslice_folded__"
+
+
+def _fold_block(bp: dict, emb: int, heads: int, head_dim: int,
+                dtype) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fold the block's attention projections (f32, O(E²·H·D) — independent
+    of the token/batch axes).
+
+    Returns ``wqk (E, H·E)`` with the Q1 dual ``head_dim**-0.25`` scaling
+    folded in, and ``wvu (H·E, E)``.
+    """
+    at = bp["attention"]
+    wq = at["toqueries"]["kernel"].astype(jnp.float32)   # (E, H·D)
+    wk = at["tokeys"]["kernel"].astype(jnp.float32)
+    wv = at["tovalues"]["kernel"].astype(jnp.float32)
+    wu = at["unifyheads"]["kernel"].astype(jnp.float32)  # (H·D, E)
+    h, d, e = heads, head_dim, emb
+    wq_h = wq.reshape(e, h, d)
+    wk_h = wk.reshape(e, h, d)
+    wv_h = wv.reshape(e, h, d)
+    wu_h = wu.reshape(h, d, e)
+    # Q1: queries AND keys are each scaled by d**-0.25 → d**-0.5 on logits
+    wqk = jnp.einsum("ehd,fhd->ehf", wq_h, wk_h) * (d ** -0.5)   # (E, H, E)
+    wvu = jnp.einsum("ehd,hdf->hef", wv_h, wu_h)                 # (H, E, E)
+    return (wqk.reshape(e, h * e).astype(dtype),
+            wvu.reshape(h * e, e).astype(dtype))
+
+
+def fold_transformer(tf_params: dict, *, emb: int, heads: int,
+                     head_dim: int, depth: int, dtype) -> dict:
+    """Pre-fold every block's attention projections ONCE. The fold is
+    differentiable (einsums of the raw kernels), so gradients flow back to
+    the original parameters unchanged. Callers whose forward sits inside a
+    ``lax.scan`` body (rollout step, learner unroll) should fold OUTSIDE the
+    scan and pass the result through — relying on XLA's loop-invariant code
+    motion to hoist the fold dots is not guaranteed."""
+    blocks = []
+    for i in range(depth):
+        bp = tf_params[f"block_{i}"]
+        wqk, wvu = _fold_block(bp, emb, heads, head_dim, dtype)
+        blocks.append({"wqk": wqk, "wvu": wvu,
+                       "u_bias": bp["attention"]["unifyheads"]["bias"],
+                       "n1": bp["norm1"], "n2": bp["norm2"],
+                       "ff1": bp["ff1"], "ff2": bp["ff2"]})
+    return {FOLDED: True, "blocks": blocks}
+
+
+def transformer_rows(tf_folded: dict, k0: jnp.ndarray, x0: jnp.ndarray, *,
+                     emb: int, heads: int, depth: int,
+                     dtype=jnp.float32) -> jnp.ndarray:
+    """Carry ``R`` query rows through ``depth`` pre-folded blocks against
+    the pinned layer-0 keys ``k0 (S, T, E)``. ``x0 (S, R, E)`` must be the
+    slice of ``k0`` rows whose outputs are consumed (agent: row 0; mixer:
+    the last ``n_agents+3`` rows). Returns the final rows ``(S, R, E)`` in
+    f32."""
+    s, r, _ = x0.shape
+    for i in range(depth):
+        bp = tf_folded["blocks"][i]
+        wqk, wvu = bp["wqk"], bp["wvu"]
+        # logits over all T keys for each head, keys never materialized
+        qp = jnp.dot(x0.reshape(s * r, emb), wqk,
+                     preferred_element_type=jnp.float32)
+        qp = qp.astype(dtype).reshape(s, r * heads, emb)
+        logits = jax.lax.dot_general(
+            qp, k0, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)                 # (S, R·H, T)
+        # parity mode keeps f32 softmax; bf16 perf mode stays in bf16
+        # (mirrors models/transformer.py:101-105)
+        if dtype == jnp.float32:
+            attn = jax.nn.softmax(logits, axis=-1)
+        else:
+            attn = jax.nn.softmax(logits.astype(dtype), axis=-1)
+        attn = attn.astype(dtype)
+        ctx = jax.lax.dot_general(
+            attn, k0, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)                 # (S, R·H, E)
+        ctx = ctx.astype(dtype).reshape(s * r, heads * emb)
+        attended = (jnp.dot(ctx, wvu, preferred_element_type=jnp.float32)
+                    + bp["u_bias"].astype(jnp.float32))         # (S·R, E) f32
+
+        # Q2 post-LN residuals, f32 statistics (ops/transformer_block.py)
+        x1 = _ln(attended + x0.reshape(s * r, emb).astype(jnp.float32),
+                 bp["n1"]["scale"].astype(jnp.float32),
+                 bp["n1"]["bias"].astype(jnp.float32))
+        hid = jnp.dot(x1.astype(dtype), bp["ff1"]["kernel"].astype(dtype),
+                      preferred_element_type=jnp.float32)
+        hid = jnp.maximum(hid + bp["ff1"]["bias"].astype(jnp.float32), 0.0)
+        y = jnp.dot(hid.astype(dtype), bp["ff2"]["kernel"].astype(dtype),
+                    preferred_element_type=jnp.float32)
+        y = y + bp["ff2"]["bias"].astype(jnp.float32)
+        x2 = _ln(y + x1,
+                 bp["n2"]["scale"].astype(jnp.float32),
+                 bp["n2"]["bias"].astype(jnp.float32))
+        x0 = x2.astype(dtype).reshape(s, r, emb)
+
+    return x0.astype(jnp.float32)
+
+
+def fold_agent_params(variables: dict, *, emb: int, heads: int, depth: int,
+                      standard_heads: bool = False, dtype=jnp.float32
+                      ) -> dict:
+    """Pre-fold an agent param tree for ``agent_forward_qslice``. Call once
+    OUTSIDE any scan whose body runs the forward (rollout step fn, learner
+    unroll); the result is an ordinary pytree."""
+    if FOLDED in variables:
+        return variables
+    p = variables["params"]
+    head_dim = emb // heads if standard_heads else emb
+    return {FOLDED: True,
+            "fe": p["feat_embedding"],
+            "tf": fold_transformer(p["transformer"], emb=emb, heads=heads,
+                                   head_dim=head_dim, depth=depth,
+                                   dtype=dtype),
+            "qb": p["q_basic"]}
+
+
+def agent_forward_qslice(variables: dict, inputs: jnp.ndarray,
+                         hidden_state: jnp.ndarray, *,
+                         n_entities: int, feat_dim: int, emb: int,
+                         heads: int, depth: int, n_actions: int,
+                         standard_heads: bool = False,
+                         dtype=jnp.float32
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Drop-in for ``TransformerAgent.apply`` (non-noisy, dropout=0):
+    inputs ``(B, A, obs)``, hidden ``(B, A, emb)`` → (q, hidden').
+    Accepts either the raw flax variables or a ``fold_agent_params`` tree."""
+    f = fold_agent_params(variables, emb=emb, heads=heads, depth=depth,
+                          standard_heads=standard_heads, dtype=dtype)
+    b, a, _ = inputs.shape
+    s = b * a
+
+    x = inputs.reshape(s, n_entities, feat_dim).astype(dtype)
+    h0 = hidden_state.reshape(s, emb).astype(dtype)
+
+    fe = f["fe"]
+    embs = (jnp.dot(x, fe["kernel"].astype(dtype),
+                    preferred_element_type=jnp.float32)
+            + fe["bias"].astype(jnp.float32)).astype(dtype)     # (S, N, E)
+    # layer-0 key tokens: hidden token prepended at position 0
+    k0 = jnp.concatenate([h0[:, None, :], embs], axis=1)        # (S, T, E)
+
+    out = transformer_rows(f["tf"], k0, h0[:, None, :],
+                           emb=emb, heads=heads, depth=depth,
+                           dtype=dtype)                         # (S, 1, E)
+
+    h_new = out[:, 0, :]                                        # (S, E) f32
+    qb = f["qb"]
+    q = (jnp.dot(h_new, qb["kernel"].astype(jnp.float32))
+         + qb["bias"].astype(jnp.float32))
+    return (q.reshape(b, a, n_actions),
+            h_new.reshape(b, a, emb))
+
+
+def make_mixer_qslice(mixer):
+    """(fold_fn, apply_fn) pair closing over a ``TransformerMixer``'s
+    attributes, so callers (the learner unroll) don't re-plumb the module
+    config. ``apply_fn`` matches ``mixer.apply``'s positional signature."""
+    fold = lambda variables: fold_mixer_params(
+        variables, emb=mixer.emb, heads=mixer.heads, depth=mixer.depth,
+        standard_heads=mixer.standard_heads, dtype=mixer.dtype)
+    apply = lambda mp, qvals, h, hyper, s, o: mixer_forward_qslice(
+        mp, qvals, h, hyper, s, o,
+        n_agents=mixer.n_agents, n_entities=mixer.n_entities,
+        feat_dim=mixer.feat_dim, emb=mixer.emb, heads=mixer.heads,
+        depth=mixer.depth, pos_func=mixer.qmix_pos_func,
+        pos_func_beta=mixer.qmix_pos_func_beta,
+        state_entity_mode=mixer.state_entity_mode,
+        standard_heads=mixer.standard_heads, dtype=mixer.dtype)
+    return fold, apply
+
+
+def fold_mixer_params(variables: dict, *, emb: int, heads: int, depth: int,
+                      standard_heads: bool = False, dtype=jnp.float32
+                      ) -> dict:
+    """Pre-fold a mixer param tree for ``mixer_forward_qslice`` (see
+    ``fold_agent_params``)."""
+    if FOLDED in variables:
+        return variables
+    p = variables["params"]
+    head_dim = emb // heads if standard_heads else emb
+    return {FOLDED: True,
+            "fe": p["feat_embedding"],
+            "tf": fold_transformer(p["transformer"], emb=emb, heads=heads,
+                                   head_dim=head_dim, depth=depth,
+                                   dtype=dtype),
+            "hb": p["hyper_b2"]}
+
+
+def mixer_forward_qslice(variables: dict, qvals: jnp.ndarray,
+                         hidden_states: jnp.ndarray,
+                         hyper_weights: jnp.ndarray, states: jnp.ndarray,
+                         obs: jnp.ndarray, *,
+                         n_agents: int, n_entities: int, feat_dim: int,
+                         emb: int, heads: int, depth: int,
+                         pos_func: str, pos_func_beta: float,
+                         state_entity_mode: bool = True,
+                         standard_heads: bool = False,
+                         dtype=jnp.float32
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Drop-in for ``TransformerMixer.apply`` (dropout=0): only the last
+    ``n_agents+3`` output rows are consumed (w1 per agent, b1, w2, the b2
+    source, and the 3 recurrent hyper tokens are WITHIN those rows —
+    positions [-3:] — so one row-slice covers readout + recurrence); the
+    ``n_entities`` state-embedding rows are dead computation in the dense
+    module. Returns ``(q_tot (b,1,1), hyper (b,3,emb))``. Accepts either
+    the raw flax variables or a ``fold_mixer_params`` tree."""
+    from ..models.mixer import qmix_pos_func
+
+    f = fold_mixer_params(variables, emb=emb, heads=heads, depth=depth,
+                          standard_heads=standard_heads, dtype=dtype)
+    b = qvals.shape[0]
+
+    if state_entity_mode:
+        inputs = states.reshape(b, n_entities, feat_dim).astype(dtype)
+    else:  # Q12: all agents' obs entities
+        inputs = obs.reshape(b, n_agents * n_entities, feat_dim).astype(dtype)
+
+    fe = f["fe"]
+    embs = (jnp.dot(inputs, fe["kernel"].astype(dtype),
+                    preferred_element_type=jnp.float32)
+            + fe["bias"].astype(jnp.float32)).astype(dtype)
+
+    k0 = jnp.concatenate(
+        [embs, hidden_states.astype(dtype), hyper_weights.astype(dtype)],
+        axis=1)                                                 # (b, T, E)
+
+    r = n_agents + 3
+    out = transformer_rows(f["tf"], k0, k0[:, -r:, :],
+                           emb=emb, heads=heads, depth=depth,
+                           dtype=dtype)                         # (b, A+3, E)
+
+    w1 = out[:, :n_agents, :]                                   # (b, A, emb)
+    b1 = out[:, -3, :].reshape(b, 1, emb)
+    w2 = out[:, -2, :].reshape(b, emb, 1)
+    hb = f["hb"]
+    b2 = jax.nn.relu(
+        jnp.dot(out[:, -1, :], hb["kernel"].astype(jnp.float32))
+        + hb["bias"].astype(jnp.float32)).reshape(b, 1, 1)
+
+    w1 = qmix_pos_func(w1, pos_func, pos_func_beta)
+    w2 = qmix_pos_func(w2, pos_func, pos_func_beta)
+
+    hidden = jax.nn.elu(jnp.matmul(qvals.astype(jnp.float32), w1) + b1)
+    y = jnp.matmul(hidden, w2) + b2                             # (b, 1, 1)
+    return y, out[:, -3:, :]

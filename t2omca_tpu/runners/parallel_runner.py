@@ -117,6 +117,9 @@ class ParallelRunner:
         is exactly the episode in the returned batch (no re-run, no drift)."""
         b, t_len = self.batch_size, self.env.cfg.episode_limit
         key, k_reset, k_scan = jax.random.split(rs.key, 3)
+        # qslice weight folds are loop-invariant: do them once per rollout,
+        # not once per scan step (no-op on other acting paths)
+        params = self.mac.prepare_acting_params(params)
 
         # reset every lane, carrying each lane's Welford normalizer (Q4)
         reset_keys = jax.random.split(k_reset, b)

@@ -1,0 +1,281 @@
+"""Equivalence of the query-slice agent forward (ops/query_slice) with the
+dense flax module.
+
+The reduction is exact algebra (layer-0-pinned keys + token-0-only readout,
+see ops/query_slice.py docstring), so forward outputs AND gradients must
+match the dense ``TransformerAgent.apply`` up to float reassociation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from t2omca_tpu.config import EnvConfig, ModelConfig, TrainConfig, sanity_check
+from t2omca_tpu.controllers.basic_mac import BasicMAC
+from t2omca_tpu.models.agent import TransformerAgent
+from t2omca_tpu.ops.query_slice import agent_forward_qslice
+
+
+def _build(emb=32, heads=2, depth=2, n_agents=3, n_entities=5, feat=9,
+           n_actions=4, standard_heads=False, dtype=jnp.float32, seed=0):
+    agent = TransformerAgent(
+        n_agents=n_agents, n_entities=n_entities, feat_dim=feat, emb=emb,
+        heads=heads, depth=depth, n_actions=n_actions,
+        standard_heads=standard_heads, dtype=dtype)
+    k = jax.random.PRNGKey(seed)
+    kp, ko, kh = jax.random.split(k, 3)
+    b = 4
+    obs = jax.random.normal(ko, (b, n_agents, n_entities * feat))
+    hidden = jax.random.normal(kh, (b, n_agents, emb))
+    params = agent.init(kp, obs, hidden)
+    return agent, params, obs, hidden
+
+
+def _qslice(agent, params, obs, hidden):
+    return agent_forward_qslice(
+        params, obs, hidden, n_entities=agent.n_entities,
+        feat_dim=agent.feat_dim, emb=agent.emb, heads=agent.heads,
+        depth=agent.depth, n_actions=agent.n_actions,
+        standard_heads=agent.standard_heads, dtype=agent.dtype)
+
+
+@pytest.mark.parametrize("standard_heads", [False, True])
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_forward_matches_dense(standard_heads, depth):
+    agent, params, obs, hidden = _build(depth=depth,
+                                        standard_heads=standard_heads)
+    q_ref, h_ref = agent.apply(params, obs, hidden)
+    q_qs, h_qs = _qslice(agent, params, obs, hidden)
+    np.testing.assert_allclose(q_qs, q_ref, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(h_qs, h_ref, rtol=2e-4, atol=2e-5)
+
+
+def test_forward_matches_dense_odd_shapes():
+    # heads that don't divide emb (full-emb head mode), odd entity counts
+    agent, params, obs, hidden = _build(emb=24, heads=3, n_entities=7,
+                                        feat=11, n_actions=5)
+    q_ref, h_ref = agent.apply(params, obs, hidden)
+    q_qs, h_qs = _qslice(agent, params, obs, hidden)
+    np.testing.assert_allclose(q_qs, q_ref, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(h_qs, h_ref, rtol=2e-4, atol=2e-5)
+
+
+def test_forward_matches_dense_bf16():
+    agent, params, obs, hidden = _build(standard_heads=True, heads=4,
+                                        dtype=jnp.bfloat16)
+    q_ref, h_ref = agent.apply(params, obs, hidden)
+    q_qs, h_qs = _qslice(agent, params, obs, hidden)
+    # bf16 mantissa ~8 bits; reassociation error accumulates over 2 blocks
+    np.testing.assert_allclose(q_qs, q_ref, rtol=0.05, atol=0.05)
+    np.testing.assert_allclose(h_qs, h_ref, rtol=0.05, atol=0.05)
+
+
+def test_recurrent_unroll_matches_dense():
+    """Hidden carried through several steps stays in lockstep."""
+    agent, params, obs, hidden = _build()
+    h_d = h_q = hidden
+    key = jax.random.PRNGKey(7)
+    for t in range(4):
+        obs_t = jax.random.normal(jax.random.fold_in(key, t), obs.shape)
+        q_d, h_d = agent.apply(params, obs_t, h_d)
+        q_q, h_q = _qslice(agent, params, obs_t, h_q)
+        np.testing.assert_allclose(q_q, q_d, rtol=5e-4, atol=5e-5)
+        np.testing.assert_allclose(h_q, h_d, rtol=5e-4, atol=5e-5)
+
+
+def test_gradients_match_dense():
+    """Same function ⇒ same gradients (the learner may unroll through it)."""
+    agent, params, obs, hidden = _build()
+
+    def loss_dense(p):
+        q, h = agent.apply(p, obs, hidden)
+        return (q ** 2).sum() + (h * 0.3).sum()
+
+    def loss_qs(p):
+        q, h = _qslice(agent, p, obs, hidden)
+        return (q ** 2).sum() + (h * 0.3).sum()
+
+    from jax.flatten_util import ravel_pytree
+    g_d = jax.grad(loss_dense)(params)
+    g_q = jax.grad(loss_qs)(params)
+    flat_d, _ = ravel_pytree(g_d)
+    flat_q, _ = ravel_pytree(g_q)
+    np.testing.assert_allclose(flat_q, flat_d, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("state_entity_mode", [True, False])
+@pytest.mark.parametrize("pos_func", ["abs", "softplus"])
+def test_mixer_forward_matches_dense(state_entity_mode, pos_func):
+    from t2omca_tpu.models.mixer import TransformerMixer
+    from t2omca_tpu.ops.query_slice import mixer_forward_qslice
+
+    n_agents, n_entities, feat, emb = 3, 3, 8, 16
+    mixer = TransformerMixer(
+        n_agents=n_agents, n_entities=n_entities, feat_dim=feat, emb=emb,
+        heads=2, depth=2, qmix_pos_func=pos_func,
+        state_entity_mode=state_entity_mode)
+    k = jax.random.PRNGKey(5)
+    b = 4
+    qvals = jax.random.normal(jax.random.fold_in(k, 0), (b, 1, n_agents))
+    hiddens = jax.random.normal(jax.random.fold_in(k, 1), (b, n_agents, emb))
+    hyper = jax.random.normal(jax.random.fold_in(k, 2), (b, 3, emb))
+    states = jax.random.normal(jax.random.fold_in(k, 3),
+                               (b, n_entities * feat))
+    obs = jax.random.normal(jax.random.fold_in(k, 4),
+                            (b, n_agents, n_entities * feat))
+    params = mixer.init(k, qvals, hiddens, hyper, states, obs)
+
+    q_ref, hy_ref = mixer.apply(params, qvals, hiddens, hyper, states, obs)
+    q_qs, hy_qs = mixer_forward_qslice(
+        params, qvals, hiddens, hyper, states, obs,
+        n_agents=n_agents, n_entities=n_entities, feat_dim=feat, emb=emb,
+        heads=2, depth=2, pos_func=pos_func, pos_func_beta=1.0,
+        state_entity_mode=state_entity_mode)
+    np.testing.assert_allclose(q_qs, q_ref, rtol=5e-4, atol=5e-5)
+    np.testing.assert_allclose(hy_qs, hy_ref, rtol=5e-4, atol=5e-5)
+
+
+def test_mixer_gradients_match_dense():
+    """The learner differentiates through mixer_forward_qslice — pin its
+    backward against the dense module, through the pre-fold."""
+    from jax.flatten_util import ravel_pytree
+    from t2omca_tpu.models.mixer import TransformerMixer
+    from t2omca_tpu.ops.query_slice import mixer_forward_qslice
+
+    n_agents, n_entities, feat, emb = 3, 3, 8, 16
+    mixer = TransformerMixer(
+        n_agents=n_agents, n_entities=n_entities, feat_dim=feat, emb=emb,
+        heads=2, depth=2)
+    k = jax.random.PRNGKey(11)
+    b = 4
+    qvals = jax.random.normal(jax.random.fold_in(k, 0), (b, 1, n_agents))
+    hiddens = jax.random.normal(jax.random.fold_in(k, 1), (b, n_agents, emb))
+    hyper = jax.random.normal(jax.random.fold_in(k, 2), (b, 3, emb))
+    states = jax.random.normal(jax.random.fold_in(k, 3),
+                               (b, n_entities * feat))
+    obs = jax.random.normal(jax.random.fold_in(k, 4),
+                            (b, n_agents, n_entities * feat))
+    params = mixer.init(k, qvals, hiddens, hyper, states, obs)
+
+    def loss_dense(p):
+        q, hy = mixer.apply(p, qvals, hiddens, hyper, states, obs)
+        return (q ** 2).sum() + (hy * 0.3).sum()
+
+    def loss_qs(p):
+        q, hy = mixer_forward_qslice(
+            p, qvals, hiddens, hyper, states, obs,
+            n_agents=n_agents, n_entities=n_entities, feat_dim=feat,
+            emb=emb, heads=2, depth=2, pos_func="abs", pos_func_beta=1.0)
+        return (q ** 2).sum() + (hy * 0.3).sum()
+
+    flat_d, _ = ravel_pytree(jax.grad(loss_dense)(params))
+    flat_q, _ = ravel_pytree(jax.grad(loss_qs)(params))
+    np.testing.assert_allclose(flat_q, flat_d, rtol=2e-3, atol=2e-4)
+
+
+def test_prefolded_params_match_unfolded():
+    """prepare_acting_params + forward_qslice ≡ raw-params forward_qslice."""
+    agent, params, obs, hidden = _build()
+    q_raw, h_raw = _qslice(agent, params, obs, hidden)
+    from t2omca_tpu.ops.query_slice import fold_agent_params
+    folded = fold_agent_params(params, emb=agent.emb, heads=agent.heads,
+                               depth=agent.depth,
+                               standard_heads=agent.standard_heads,
+                               dtype=agent.dtype)
+    q_f, h_f = _qslice(agent, folded, obs, hidden)
+    np.testing.assert_array_equal(q_f, q_raw)
+    np.testing.assert_array_equal(h_f, h_raw)
+
+
+def test_learner_loss_matches_dense_path():
+    """End-to-end: the learner's loss/priorities with qslice unrolls match
+    the dense-path learner bit-for-tolerance on the same batch."""
+    import dataclasses
+    from t2omca_tpu.run import Experiment
+
+    def build(use_qslice):
+        cfg = sanity_check(TrainConfig(
+            batch_size_run=2, batch_size=2, test_nepisode=2,
+            env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                               episode_limit=6),
+            model=ModelConfig(emb=16, heads=2, depth=1, mixer_emb=16,
+                              mixer_heads=2, mixer_depth=1,
+                              use_qslice=use_qslice),
+        ))
+        return Experiment.build(cfg)
+
+    exp_qs, exp_d = build(True), build(False)
+    assert exp_qs.mac.use_qslice and not exp_d.mac.use_qslice
+    ts = exp_qs.init_train_state(0)
+    rs, batch, _ = jax.jit(exp_qs.runner.run)(
+        ts.learner.params["agent"], ts.runner)
+    w = jnp.ones((2,))
+    _, info_qs = exp_qs.learner.train(
+        ts.learner, batch, w, jnp.asarray(0), jnp.asarray(0))
+    _, info_d = exp_d.learner.train(
+        ts.learner, batch, w, jnp.asarray(0), jnp.asarray(0))
+    np.testing.assert_allclose(info_qs["loss"], info_d["loss"],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(info_qs["td_errors_abs"],
+                               info_d["td_errors_abs"],
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_mac_build_resolves_eligibility():
+    env_info = {"n_agents": 3, "n_entities": 3, "obs_entity_feats": 9,
+                "obs_shape": 27, "n_actions": 4, "state_shape": 24,
+                "episode_limit": 5}
+    cfg = sanity_check(TrainConfig(
+        env_args=EnvConfig(agv_num=3, mec_num=2, episode_limit=5),
+        model=ModelConfig(emb=16, heads=2, depth=1,
+                          mixer_emb=16, mixer_heads=2)))
+    assert BasicMAC.build(cfg, env_info).use_qslice
+
+    # dropout>0 → dense fallback (dropout must actually be sampled)
+    import dataclasses
+    cfg_do = cfg.replace(model=dataclasses.replace(cfg.model, dropout=0.1))
+    assert not BasicMAC.build(cfg_do, env_info).use_qslice
+
+    # noisy selector → dense fallback (NoisyLinear q-head)
+    cfg_noisy = cfg.replace(action_selector="noisy-new")
+    assert not BasicMAC.build(cfg_noisy, env_info).use_qslice
+
+    # rnn agent → dense fallback
+    cfg_rnn = cfg.replace(agent="rnn", mixer="vdn")
+    assert not BasicMAC.build(cfg_rnn, env_info).use_qslice
+
+    # explicit pallas request wins over the qslice default
+    cfg_pl = cfg.replace(model=dataclasses.replace(cfg.model,
+                                                   use_pallas=True))
+    mac_pl = BasicMAC.build(cfg_pl, env_info)
+    assert mac_pl.use_pallas and not mac_pl.use_qslice
+
+
+def test_select_actions_matches_dense_greedy():
+    """Greedy rollout actions agree between the qslice and dense paths."""
+    import dataclasses
+    env_info = {"n_agents": 3, "n_entities": 3, "obs_entity_feats": 9,
+                "obs_shape": 27, "n_actions": 4, "state_shape": 24,
+                "episode_limit": 5}
+    cfg = sanity_check(TrainConfig(
+        env_args=EnvConfig(agv_num=3, mec_num=2, episode_limit=5),
+        model=ModelConfig(emb=16, heads=2, depth=1,
+                          mixer_emb=16, mixer_heads=2)))
+    mac_qs = BasicMAC.build(cfg, env_info)
+    cfg_dense = cfg.replace(
+        model=dataclasses.replace(cfg.model, use_qslice=False))
+    mac_dense = BasicMAC.build(cfg_dense, env_info)
+    assert mac_qs.use_qslice and not mac_dense.use_qslice
+
+    key = jax.random.PRNGKey(3)
+    params = mac_qs.init_params(key, 27)
+    obs = jax.random.normal(jax.random.fold_in(key, 1), (6, 3, 27))
+    avail = jnp.ones((6, 3, 4), jnp.int32)
+    hidden = mac_qs.init_hidden(6)
+    t_env = jnp.asarray(0)
+    a_qs, h_qs, _ = mac_qs.select_actions(
+        params, obs, avail, hidden, key, t_env, test_mode=True)
+    a_d, h_d, _ = mac_dense.select_actions(
+        params, obs, avail, hidden, key, t_env, test_mode=True)
+    np.testing.assert_array_equal(a_qs, a_d)
+    np.testing.assert_allclose(h_qs, h_d, rtol=5e-4, atol=5e-5)
