@@ -65,6 +65,9 @@ def breakdown(cfg, exp, ts, _time, args) -> int:
     avail = jnp.ones((b, env.n_agents, env.n_actions), jnp.int32)
 
     def acting(params):
+        # fold qslice weights outside the scan, as runner.run does
+        params = mac.prepare_acting_params(params)
+
         def step_fn(carry, key_t):
             hidden, t_env = carry
             actions, hidden, _ = mac.select_actions(
@@ -84,9 +87,11 @@ def breakdown(cfg, exp, ts, _time, args) -> int:
     rows["full"] = _time(full)
 
     env_steps = b * t_len
+    acting_mode = ("pallas" if cfg.model.use_pallas
+                   else "qslice" if mac.use_qslice else "dense")
     print(f"# breakdown at {b} envs x {t_len} slots "
           f"({cfg.env_args.agv_num} AGVs, d{cfg.model.emb}, "
-          f"pallas={cfg.model.use_pallas})", file=sys.stderr)
+          f"acting={acting_mode})", file=sys.stderr)
     for k, v in rows.items():
         print(f"#   {k:10s} {v * 1e3:8.1f} ms "
               f"({env_steps / v:,.0f} env-steps/s)", file=sys.stderr)
@@ -94,7 +99,7 @@ def breakdown(cfg, exp, ts, _time, args) -> int:
     return 0
 
 
-def bench_train(cfg, _time, args) -> int:
+def _train_numbers(cfg, _time, train_bs: int | None = None) -> dict:
     """Learner-side throughput — the second half of the north-star metric
     (BASELINE.json: "env-steps/sec/chip + mixer train-steps/sec").
 
@@ -110,7 +115,7 @@ def bench_train(cfg, _time, args) -> int:
 
     from t2omca_tpu.run import Experiment
 
-    bs = 4 if args.smoke else 32
+    bs = train_bs or 32
     cfg = cfg.replace(
         batch_size=bs,
         replay=dataclasses.replace(cfg.replay, prioritized=True,
@@ -149,12 +154,23 @@ def bench_train(cfg, _time, args) -> int:
     print(f"# interleaved rollout+insert+train: {dt_full * 1e3:.1f} ms -> "
           f"{env_steps / dt_full:,.0f} env-steps/s incl. training",
           file=sys.stderr)
-    print(json.dumps({
-        "metric": "train_steps_per_sec",
-        "value": round(1.0 / dt_train, 2),
-        "unit": "train-steps/s/chip",
+    return {
+        "train_steps_per_sec": round(1.0 / dt_train, 2),
         "interleaved_env_steps_per_sec": round(env_steps / dt_full, 1),
         "train_batch_episodes": bs,
+    }
+
+
+def bench_train(cfg, _time, args) -> int:
+    """``--train``: the learner measurement alone, as the headline line."""
+    nums = _train_numbers(cfg, _time, train_bs=4 if args.smoke else 32)
+    print(json.dumps({
+        "metric": "train_steps_per_sec",
+        "value": nums["train_steps_per_sec"],
+        "unit": "train-steps/s/chip",
+        "interleaved_env_steps_per_sec":
+            nums["interleaved_env_steps_per_sec"],
+        "train_batch_episodes": nums["train_batch_episodes"],
         "vs_baseline": None,
     }))
     return 0
@@ -166,9 +182,15 @@ def main() -> int:
     ap.add_argument("--envs", type=int, default=None)
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--iters", type=int, default=3)
-    ap.add_argument("--no-pallas", action="store_true",
-                    help="XLA acting path (reproduces the BASELINE.md "
+    ap.add_argument("--acting", choices=("qslice", "pallas", "dense"),
+                    default="qslice",
+                    help="agent forward for the rollout: qslice (exact "
+                         "token-0-only reduction, ops/query_slice — the "
+                         "default), pallas (fused-block kernel), dense "
+                         "(XLA full forward; reproduces the BASELINE.md "
                          "XLA-path row)")
+    ap.add_argument("--no-pallas", action="store_true",
+                    help="deprecated alias for --acting dense")
     ap.add_argument("--no-fast-norm", action="store_true",
                     help="sequential per-agent Welford (reference-exact "
                          "normalizer ordering) instead of the batched merge")
@@ -185,6 +207,8 @@ def main() -> int:
     ap.add_argument("--tile", type=int, default=16,
                     help="Pallas kernel tile (sequences per grid step)")
     args = ap.parse_args()
+    if args.no_pallas:
+        args.acting = "dense"
 
     if args.smoke:
         import jax
@@ -205,7 +229,9 @@ def main() -> int:
             env_args=EnvConfig(agv_num=4, mec_num=2, num_channels=2,
                                episode_limit=steps),
             model=ModelConfig(emb=16, heads=2, depth=1, mixer_emb=16,
-                              mixer_heads=2, mixer_depth=1),
+                              mixer_heads=2, mixer_depth=1,
+                              use_pallas=args.acting == "pallas",
+                              use_qslice=args.acting != "dense"),
             replay=ReplayConfig(buffer_size=16),
         ))
     else:
@@ -225,7 +251,11 @@ def main() -> int:
                               mixer_emb=256, mixer_heads=args.heads,
                               mixer_depth=2,
                               standard_heads=True, dtype="bfloat16",
-                              use_pallas=not args.no_pallas,
+                              use_pallas=args.acting == "pallas",
+                              # production pallas configs leave qslice on —
+                              # the learner trains through it regardless of
+                              # the acting kernel (QMixLearner._agent_qslice)
+                              use_qslice=args.acting != "dense",
                               pallas_tile=args.tile),
             replay=ReplayConfig(buffer_size=4, store_dtype="bfloat16"),
         ))
@@ -284,12 +314,24 @@ def main() -> int:
           f"({n_envs} envs × {steps} slots, {cfg.env_args.agv_num} AGVs)",
           file=sys.stderr)
 
-    print(json.dumps({
+    line = {
         "metric": "env_steps_per_sec",
         "value": round(rate, 1),
         "unit": "env-steps/s/chip",
         "vs_baseline": round(rate / 50_000.0, 3),
-    }))
+    }
+
+    # the north-star metric is BOTH halves ("env-steps/sec/chip + mixer
+    # train-steps/sec", BASELINE.json): append the learner measurement to
+    # the default line so every driver bench records it. Guarded — a train
+    # failure must not cost the headline number.
+    if not args.smoke:
+        try:
+            line.update(_train_numbers(cfg, _time))
+        except Exception as e:      # pragma: no cover - defensive
+            print(f"# train bench failed: {e!r}", file=sys.stderr)
+
+    print(json.dumps(line))
     return 0
 
 

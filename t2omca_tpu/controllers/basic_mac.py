@@ -85,13 +85,10 @@ class BasicMAC:
         schedule = DecayThenFlatSchedule(
             cfg.epsilon_start, cfg.epsilon_finish, cfg.epsilon_anneal_time)
         selector = SELECTOR_REGISTRY[cfg.action_selector](schedule)
-        # query-slice eligibility: exact only for the deterministic
-        # transformer path (no dropout to sample, no NoisyLinear q-head);
-        # an explicit use_pallas request keeps the kernel path
-        use_qslice = (cfg.model.use_qslice and not use_pallas
-                      and cfg.agent == "transformer"
-                      and cfg.model.dropout == 0.0
-                      and cfg.action_selector != "noisy-new")
+        # query-slice eligibility (shared predicate, ops/query_slice.py);
+        # an explicit use_pallas request keeps the kernel acting path
+        from ..ops.query_slice import agent_qslice_eligible
+        use_qslice = agent_qslice_eligible(cfg) and not use_pallas
         return cls(agent=agent, selector=selector, n_agents=n_agents,
                    n_actions=env_info["n_actions"], emb=cfg.model.emb,
                    use_pallas=use_pallas,

@@ -125,22 +125,17 @@ class QMixLearner:
 
     @property
     def _agent_qslice(self) -> bool:
-        """Query-slice agent unroll eligibility for the LEARNER: unlike
+        """Learner-side qslice eligibility (shared predicate): unlike
         ``mac.use_qslice`` this ignores ``use_pallas`` — the Pallas kernel
         owns only the acting path (it has no VJP), so a pallas config still
         trains on the exact differentiable qslice forward."""
-        return (self.cfg.model.use_qslice
-                and self.cfg.agent == "transformer"
-                and self.cfg.model.dropout == 0.0
-                and self.cfg.action_selector != "noisy-new")
+        from ..ops.query_slice import agent_qslice_eligible
+        return agent_qslice_eligible(self.cfg)
 
     @property
     def _mixer_qslice(self) -> bool:
-        """Row-sliced mixer forward (ops/query_slice): exact for the
-        deterministic transformer mixer — only the last ``n_agents+3``
-        output rows are consumed (models/mixer.py:96-109)."""
-        return (self.cfg.model.use_qslice and self.cfg.mixer == "transformer"
-                and self.cfg.model.dropout == 0.0)
+        from ..ops.query_slice import mixer_qslice_eligible
+        return mixer_qslice_eligible(self.cfg)
 
     @property
     def needs_rngs(self) -> bool:

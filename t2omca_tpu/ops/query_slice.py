@@ -66,6 +66,26 @@ def _ln(x32: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray
 FOLDED = "__qslice_folded__"
 
 
+def agent_qslice_eligible(cfg) -> bool:
+    """Single source of truth for agent-side eligibility: the reduction is
+    exact only for the deterministic transformer forward (no dropout mask
+    to sample, no NoisyLinear q-head). Consumers: ``BasicMAC.build`` (which
+    additionally lets an explicit ``use_pallas`` own the acting path) and
+    ``QMixLearner`` (which ignores ``use_pallas`` — the kernel has no VJP)."""
+    return (cfg.model.use_qslice
+            and cfg.agent == "transformer"
+            and cfg.model.dropout == 0.0
+            and cfg.action_selector != "noisy-new")
+
+
+def mixer_qslice_eligible(cfg) -> bool:
+    """Mixer-side eligibility: deterministic transformer mixer only (only
+    the last ``n_agents+3`` output rows are consumed, models/mixer.py)."""
+    return (cfg.model.use_qslice
+            and cfg.mixer == "transformer"
+            and cfg.model.dropout == 0.0)
+
+
 def _fold_block(bp: dict, emb: int, heads: int, head_dim: int,
                 dtype) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fold the block's attention projections (f32, O(E²·H·D) — independent
