@@ -176,12 +176,33 @@ def bench_train(cfg, _time, args) -> int:
     return 0
 
 
+#: BASELINE.json measurement scale points (see BASELINE.md §configs):
+#: (agv, mec, channels, envs, d_model, depth) — config 4 adds PER scale,
+#: config 5 is the DP=8 point (needs ≥8 devices; compile-checked by the
+#: multichip dryrun, measured per-chip here when a slice is available)
+_CONFIGS = {
+    1: dict(agv=4, mec=2, ch=2, envs=1, emb=64, depth=2),
+    2: dict(agv=16, mec=4, ch=4, envs=256, emb=128, depth=2),
+    3: dict(agv=64, mec=8, ch=8, envs=1024, emb=256, depth=2),
+    4: dict(agv=64, mec=8, ch=8, envs=4096, emb=256, depth=2),
+    5: dict(agv=256, mec=16, ch=16, envs=8192, emb=256, depth=2),
+}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--config", type=int, choices=sorted(_CONFIGS),
+                    default=3,
+                    help="BASELINE.json measurement config (default 3, the "
+                         "north-star scale point; 4 = PER/train scale, "
+                         "5 = the DP=8 point — needs 8 devices)")
     ap.add_argument("--envs", type=int, default=None)
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--profile", default="",
+                    help="capture a jax.profiler trace of the timed "
+                         "iterations into this directory")
     ap.add_argument("--acting", choices=("qslice", "pallas", "dense"),
                     default="qslice",
                     help="agent forward for the rollout: qslice (exact "
@@ -235,21 +256,25 @@ def main() -> int:
             replay=ReplayConfig(buffer_size=16),
         ))
     else:
-        # north-star scale point (BASELINE.json configs[2]): 64 AGVs × 8 MEC,
-        # 1024 envs, d_model 256. episode_limit is shortened for the timed
-        # program (throughput is per-step; the full 150-slot episode batch at
-        # entity obs 64×576 would exceed single-chip HBM — the training
-        # config shards it over the data axis instead).
-        n_envs = args.envs or 1024
+        # BASELINE.json measurement scale points; default = config 3, the
+        # north-star point (64 AGVs × 8 MEC, 1024 envs, d_model 256).
+        # episode_limit is shortened for the timed program (throughput is
+        # per-step; the full 150-slot episode batch at entity obs 64×576
+        # would exceed single-chip HBM — the training config shards it over
+        # the data axis instead).
+        c = _CONFIGS[args.config]
+        n_envs = args.envs or c["envs"]
         steps = args.steps or 32
         cfg = sanity_check(TrainConfig(
             batch_size_run=n_envs,
-            env_args=EnvConfig(agv_num=64, mec_num=8, num_channels=8,
+            env_args=EnvConfig(agv_num=c["agv"], mec_num=c["mec"],
+                               num_channels=c["ch"],
                                episode_limit=steps,
                                fast_norm=not args.no_fast_norm),
-            model=ModelConfig(emb=256, heads=args.heads, depth=2,
-                              mixer_emb=256, mixer_heads=args.heads,
-                              mixer_depth=2,
+            model=ModelConfig(emb=c["emb"], heads=args.heads,
+                              depth=c["depth"],
+                              mixer_emb=c["emb"], mixer_heads=args.heads,
+                              mixer_depth=c["depth"],
                               standard_heads=True, dtype="bfloat16",
                               use_pallas=args.acting == "pallas",
                               # production pallas configs leave qslice on —
@@ -278,16 +303,26 @@ def main() -> int:
         fn_times.sort()
         return fn_times[len(fn_times) // 2]
 
-    if args.train:       # builds its own Experiment (PER-enabled replay)
-        return bench_train(cfg, _time, args)
+    if args.train or args.breakdown:
+        # whole-mode trace (includes compiles; the default mode traces only
+        # the timed iterations)
+        if args.profile:
+            jax.profiler.start_trace(args.profile)
+        try:
+            if args.train:   # builds its own Experiment (PER-enabled replay)
+                return bench_train(cfg, _time, args)
+            exp = Experiment.build(cfg)
+            ts = exp.init_train_state(0)
+            return breakdown(cfg, exp, ts, _time, args)
+        finally:
+            if args.profile:
+                jax.profiler.stop_trace()
+                print(f"# trace written to {args.profile}", file=sys.stderr)
 
     exp = Experiment.build(cfg)
     ts = exp.init_train_state(0)
     rollout = jax.jit(exp.runner.run, static_argnames="test_mode")
     params = ts.learner.params["agent"]
-
-    if args.breakdown:
-        return breakdown(cfg, exp, ts, _time, args)
 
     # compile + warm-up (two runs: tunnel queues make the first timed run
     # unrepresentative)
@@ -300,12 +335,17 @@ def main() -> int:
     print(f"# compile+first-run: {compile_s:.1f}s  "
           f"devices={jax.devices()}", file=sys.stderr)
 
+    if args.profile:
+        jax.profiler.start_trace(args.profile)
     times = []
     for _ in range(args.iters):
         t0 = time.perf_counter()
         rs, batch, stats = rollout(params, rs, test_mode=False)
         _sync(batch.reward[0, 0])
         times.append(time.perf_counter() - t0)
+    if args.profile:
+        jax.profiler.stop_trace()
+        print(f"# trace written to {args.profile}", file=sys.stderr)
     times.sort()
     dt = times[len(times) // 2]
     env_steps = cfg.batch_size_run * cfg.env_args.episode_limit
@@ -319,13 +359,18 @@ def main() -> int:
         "value": round(rate, 1),
         "unit": "env-steps/s/chip",
         "vs_baseline": round(rate / 50_000.0, 3),
+        "config": None if args.smoke else args.config,
+        "acting": args.acting,
     }
 
     # the north-star metric is BOTH halves ("env-steps/sec/chip + mixer
     # train-steps/sec", BASELINE.json): append the learner measurement to
-    # the default line so every driver bench records it. Guarded — a train
-    # failure must not cost the headline number.
+    # the default line so every driver bench records it. The headline is
+    # preserved on stderr first (and the first Experiment's device state
+    # dropped) so even a process-fatal train failure cannot cost it.
     if not args.smoke:
+        print(f"# headline: {json.dumps(line)}", file=sys.stderr, flush=True)
+        del ts, rs, batch, stats, rollout, params, exp
         try:
             line.update(_train_numbers(cfg, _time))
         except Exception as e:      # pragma: no cover - defensive
