@@ -303,21 +303,30 @@ def main() -> int:
         fn_times.sort()
         return fn_times[len(fn_times) // 2]
 
+    import contextlib
+
+    @contextlib.contextmanager
+    def tracing():
+        if not args.profile:
+            yield
+            return
+        jax.profiler.start_trace(args.profile)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+            print(f"# trace written to {args.profile}", file=sys.stderr,
+                  flush=True)
+
     if args.train or args.breakdown:
         # whole-mode trace (includes compiles; the default mode traces only
         # the timed iterations)
-        if args.profile:
-            jax.profiler.start_trace(args.profile)
-        try:
+        with tracing():
             if args.train:   # builds its own Experiment (PER-enabled replay)
                 return bench_train(cfg, _time, args)
             exp = Experiment.build(cfg)
             ts = exp.init_train_state(0)
             return breakdown(cfg, exp, ts, _time, args)
-        finally:
-            if args.profile:
-                jax.profiler.stop_trace()
-                print(f"# trace written to {args.profile}", file=sys.stderr)
 
     exp = Experiment.build(cfg)
     ts = exp.init_train_state(0)
@@ -335,17 +344,13 @@ def main() -> int:
     print(f"# compile+first-run: {compile_s:.1f}s  "
           f"devices={jax.devices()}", file=sys.stderr)
 
-    if args.profile:
-        jax.profiler.start_trace(args.profile)
     times = []
-    for _ in range(args.iters):
-        t0 = time.perf_counter()
-        rs, batch, stats = rollout(params, rs, test_mode=False)
-        _sync(batch.reward[0, 0])
-        times.append(time.perf_counter() - t0)
-    if args.profile:
-        jax.profiler.stop_trace()
-        print(f"# trace written to {args.profile}", file=sys.stderr)
+    with tracing():
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            rs, batch, stats = rollout(params, rs, test_mode=False)
+            _sync(batch.reward[0, 0])
+            times.append(time.perf_counter() - t0)
     times.sort()
     dt = times[len(times) // 2]
     env_steps = cfg.batch_size_run * cfg.env_args.episode_limit
@@ -359,7 +364,12 @@ def main() -> int:
         "value": round(rate, 1),
         "unit": "env-steps/s/chip",
         "vs_baseline": round(rate / 50_000.0, 3),
-        "config": None if args.smoke else args.config,
+        # a config id only when the run actually measured that scale point
+        # (smoke and --envs/--steps overrides would misattribute the number)
+        "config": (None if args.smoke or args.envs or args.steps
+                   else args.config),
+        "n_envs": n_envs,
+        "episode_steps": steps,
         "acting": args.acting,
     }
 

@@ -119,14 +119,21 @@ class Experiment:
 
     # ------------------------------------------------------------------ programs
 
-    def jitted_programs(self, constrain_batch=None):
+    def jitted_programs(self, constrain_batch=None, donate: bool = False):
         """→ (rollout, insert, train_iter) jitted programs.
 
         ``constrain_batch`` is an optional ``EpisodeBatch → EpisodeBatch``
         hook applied to rollout outputs and training samples — the
         multi-chip path (``parallel.DataParallel``) injects a
         ``with_sharding_constraint`` through it so both paths share one
-        train-iteration definition."""
+        train-iteration definition.
+
+        ``donate=True`` donates the replay ring to ``insert`` and the train
+        state to ``train_iter`` — XLA then updates both in place instead of
+        copying the (largest-on-chip) buffer arrays every call. Only for
+        callers that never reuse the pre-call state (the ``run_sequential``
+        loop replaces it immediately); benches/tests that re-time a program
+        on the same inputs must keep the default."""
         runner, buffer, learner, cfg = (self.runner, self.buffer,
                                         self.learner, self.cfg)
         constrain = constrain_batch or (lambda b: b)
@@ -160,7 +167,8 @@ class Experiment:
 
             return rollout, insert, train_iter_host
 
-        insert = jax.jit(buffer.insert_episode_batch)
+        insert = jax.jit(buffer.insert_episode_batch,
+                         donate_argnums=(0,) if donate else ())
 
         def _train_iter(ts: TrainState, key: jax.Array, t_env: jnp.ndarray):
             """sample → train → priority feedback, as one program."""
@@ -174,7 +182,8 @@ class Experiment:
                 ts.buffer, idx, info["td_errors_abs"] + 1e-6)   # Q9
             return ts.replace(learner=learner_state, buffer=buf), info
 
-        return rollout, insert, jax.jit(_train_iter)
+        return rollout, insert, jax.jit(
+            _train_iter, donate_argnums=(0,) if donate else ())
 
 
 def run(cfg: TrainConfig, logger: Optional[Logger] = None) -> TrainState:
@@ -207,7 +216,9 @@ def run_sequential(exp: Experiment, logger: Logger,
     log.info(f"env_info: {env_info}")
 
     ts = exp.init_train_state(cfg.seed)
-    rollout, insert, train_iter = exp.jitted_programs()
+    # the driver loop replaces its state right after every call, so the
+    # replay ring / train state can be donated (in-place on device)
+    rollout, insert, train_iter = exp.jitted_programs(donate=True)
     key = jax.random.PRNGKey(cfg.seed + 1)
 
     t_env = 0
