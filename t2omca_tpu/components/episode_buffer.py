@@ -43,7 +43,8 @@ class EpisodeBatch:
 
     obs: jnp.ndarray            # (B, T+1, A, obs_dim) float32
     state: jnp.ndarray          # (B, T+1, state_dim) float32
-    avail_actions: jnp.ndarray  # (B, T+1, A, n_actions) int32
+    avail_actions: jnp.ndarray  # (B, T+1, A, n_actions) int8 (storage; all
+                                # consumers only compare > 0)
     actions: jnp.ndarray        # (B, T, A) int32
     reward: jnp.ndarray         # (B, T) float32
     terminated: jnp.ndarray     # (B, T) bool — env-terminal, time-limit excluded (Q7)
@@ -81,7 +82,7 @@ def _zeros_like_episode(n_agents: int, n_actions: int, obs_dim: int,
     return EpisodeBatch(
         obs=jnp.zeros((batch, t + 1, n_agents, obs_dim), store_dtype),
         state=jnp.zeros((batch, t + 1, state_dim), store_dtype),
-        avail_actions=jnp.zeros((batch, t + 1, n_agents, n_actions), jnp.int32),
+        avail_actions=jnp.zeros((batch, t + 1, n_agents, n_actions), jnp.int8),
         actions=jnp.zeros((batch, t, n_agents), jnp.int32),
         reward=jnp.zeros((batch, t), jnp.float32),
         terminated=jnp.zeros((batch, t), bool),
@@ -134,8 +135,11 @@ class ReplayBuffer:
                 f"{self.capacity}; raise replay.buffer_size above "
                 f"batch_size_run")
         idx = (state.insert_pos + jnp.arange(b)) % self.capacity
+        # cast to the ring's storage dtypes (int32-avail producers stay
+        # legal; scatter dtype mismatches become hard errors in newer JAX)
         storage = jax.tree.map(
-            lambda s, x: s.at[idx].set(x), state.storage, batch)
+            lambda s, x: s.at[idx].set(x.astype(s.dtype)), state.storage,
+            batch)
         return state.replace(
             storage=storage,
             insert_pos=(state.insert_pos + b) % self.capacity,

@@ -136,9 +136,11 @@ class ParallelRunner:
                 test_mode=test_mode)
             # Q15: the action is recorded with the pre-step observation.
             # Cast to the storage dtype here so the scan stacks the compact
-            # representation (the f32 episode stack is the HBM hot spot).
+            # representation (the f32 episode stack is the HBM hot spot);
+            # avail narrows to int8 — every consumer only compares > 0
             sd = jnp.dtype(self.cfg.replay.store_dtype)
-            pre = (obs.astype(sd), gstate.astype(sd), avail, actions)
+            pre = (obs.astype(sd), gstate.astype(sd),
+                   avail.astype(jnp.int8), actions)
             viz = ((env_states.pos, env_states.mec_index)
                    if capture else None)
             env_states, reward, terminated, info, obs, gstate, avail = \
@@ -165,7 +167,7 @@ class ParallelRunner:
         batch = EpisodeBatch(
             obs=cat_last(obs_seq, last_obs.astype(sd)),
             state=cat_last(gstate_seq, last_gstate.astype(sd)),
-            avail_actions=cat_last(avail_seq, last_avail),
+            avail_actions=cat_last(avail_seq, last_avail.astype(jnp.int8)),
             actions=bt(action_seq),
             reward=bt(reward),
             terminated=bt(env_terminal),
