@@ -70,8 +70,15 @@ def breakdown(cfg, exp, ts, _time, args) -> int:
 
         def step_fn(carry, key_t):
             hidden, t_env = carry
+            # entity-table acting recomputes the factored obs per step in
+            # the real rollout scan — pay it here too for honest
+            # attribution (XLA may still hoist this loop-invariant copy;
+            # the 'full' row is the ground truth either way)
+            compact = (jax.vmap(env.compact_obs)(rs.env_states)
+                       if mac.use_entity_tables else None)
             actions, hidden, _ = mac.select_actions(
-                params, obs, avail, hidden, key_t, t_env, test_mode=False)
+                params, obs, avail, hidden, key_t, t_env, test_mode=False,
+                compact=compact)
             return (hidden, t_env + b), actions.sum()
         (_, _), outs = jax.lax.scan(
             step_fn, (mac.init_hidden(b), jnp.zeros((), jnp.int32)),
@@ -86,8 +93,24 @@ def breakdown(cfg, exp, ts, _time, args) -> int:
         return batch.reward[0, 0]
     rows["full"] = _time(full)
 
+    # static XLA cost model of the full rollout program: attributes the
+    # compute/bandwidth budget even when a profiler trace isn't available
+    try:
+        cost = (rollout.lower(params, rs, test_mode=False)
+                .compile().cost_analysis())
+        if cost:
+            fl = cost.get("flops", 0.0)
+            by = cost.get("bytes accessed", 0.0)
+            print(f"# XLA cost model (full rollout): "
+                  f"{fl / 1e12:.2f} TFLOP, {by / 1e9:.2f} GB accessed -> "
+                  f"{fl / max(by, 1):.1f} FLOP/byte arithmetic intensity",
+                  file=sys.stderr)
+    except Exception as e:           # pragma: no cover - backend-dependent
+        print(f"# cost_analysis unavailable: {e!r}", file=sys.stderr)
+
     env_steps = b * t_len
     acting_mode = ("pallas" if cfg.model.use_pallas
+                   else "entity" if mac.use_entity_tables
                    else "qslice" if mac.use_qslice else "dense")
     print(f"# breakdown at {b} envs x {t_len} slots "
           f"({cfg.env_args.agv_num} AGVs, d{cfg.model.emb}, "

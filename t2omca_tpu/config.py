@@ -77,6 +77,11 @@ class ModelConfig:
     # noisy selector); an explicit use_pallas=True takes precedence on the
     # acting path
     use_qslice: bool = True
+    # entity-table acting (ops/query_slice.agent_forward_qslice_entity):
+    # contract attention against per-env (A, E) tables instead of
+    # materializing per-agent token embeddings; exact for entity-mode obs
+    # under fast_norm, auto-disabled otherwise
+    use_entity_tables: bool = True
     # entity counts: filled from env info when 0
     n_entities_obs: int = 0
     n_entities_state: int = 0

@@ -42,6 +42,7 @@ class BasicMAC:
     pallas_interpret: bool = False
     pallas_tile: int = 16
     use_qslice: bool = False    # exact token-0-only forward (ops/query_slice)
+    use_entity_tables: bool = False   # table-contracted entity acting
 
     @classmethod
     def build(cls, cfg: TrainConfig, env_info: dict) -> "BasicMAC":
@@ -87,14 +88,18 @@ class BasicMAC:
         selector = SELECTOR_REGISTRY[cfg.action_selector](schedule)
         # query-slice eligibility (shared predicate, ops/query_slice.py);
         # an explicit use_pallas request keeps the kernel acting path
-        from ..ops.query_slice import agent_qslice_eligible
+        from ..ops.query_slice import (agent_qslice_eligible,
+                                       entity_tables_eligible)
         use_qslice = agent_qslice_eligible(cfg) and not use_pallas
         return cls(agent=agent, selector=selector, n_agents=n_agents,
                    n_actions=env_info["n_actions"], emb=cfg.model.emb,
                    use_pallas=use_pallas,
                    pallas_interpret=jax.default_backend() == "cpu",
                    pallas_tile=cfg.model.pallas_tile,
-                   use_qslice=use_qslice)
+                   use_qslice=use_qslice,
+                   use_entity_tables=(cfg.model.use_entity_tables
+                                      and use_qslice
+                                      and entity_tables_eligible(cfg)))
 
     # ------------------------------------------------------------------ state
 
@@ -150,6 +155,18 @@ class BasicMAC:
             heads=a.heads, depth=a.depth, n_actions=a.n_actions,
             standard_heads=a.standard_heads, dtype=a.dtype)
 
+    def forward_entity(self, params, compact, hidden: jnp.ndarray
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Entity-table forward (ops/query_slice): ``compact`` is the
+        ``env.compact_obs`` tuple, batched over envs."""
+        from ..ops.query_slice import agent_forward_qslice_entity
+        rows, same_mec, mean, std = compact
+        a = self.agent
+        return agent_forward_qslice_entity(
+            params, rows, same_mec, mean, std, hidden,
+            emb=a.emb, heads=a.heads, depth=a.depth, n_actions=a.n_actions,
+            standard_heads=a.standard_heads, dtype=a.dtype)
+
     def prepare_acting_params(self, params):
         """Pre-fold the qslice projection products ONCE, outside any scan
         that calls ``select_actions``/``forward_qslice`` in its body (the
@@ -166,12 +183,17 @@ class BasicMAC:
 
     def select_actions(self, params, obs: jnp.ndarray, avail: jnp.ndarray,
                        hidden: jnp.ndarray, key: jax.Array,
-                       t_env: jnp.ndarray, test_mode: bool = False
+                       t_env: jnp.ndarray, test_mode: bool = False,
+                       compact=None
                        ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """→ (actions ``(B, A)`` int32, hidden', epsilon). The avail mask is
-        applied inside the selector (illegal-action masking, M7)."""
+        applied inside the selector (illegal-action masking, M7).
+        ``compact`` (the batched ``env.compact_obs`` tuple) activates the
+        entity-table forward when the MAC was built eligible."""
         k_noise, k_sel = jax.random.split(key)
-        if self.use_pallas:
+        if self.use_entity_tables and compact is not None:
+            q, hidden = self.forward_entity(params, compact, hidden)
+        elif self.use_pallas:
             q, hidden = self.forward_fast(params, obs, hidden)
         elif self.use_qslice:
             q, hidden = self.forward_qslice(params, obs, hidden)

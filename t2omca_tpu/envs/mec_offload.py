@@ -255,6 +255,33 @@ class MultiAgvOffloadingEnv:
         norm, obs = jax.lax.scan(body, state.norm, raw)
         return state.replace(norm=norm), obs
 
+    def compact_obs(self, state: EnvState
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                               jnp.ndarray]:
+        """Factored form of the entity observation for the entity-table
+        acting path (``ops/query_slice.agent_forward_qslice_entity``):
+        ``(rows (A, 8), same_mec (A, A) bool, mean (A, 9), std (A, 9))``.
+
+        The full entity obs (``_raw_obs``) is ``A`` copies of the same 8
+        feature rows under the same-MEC visibility mask plus an is-self
+        diagonal; with ``fast_norm`` every agent row is normalized by the
+        SAME per-position statistics (one shared ``NormState``, Q4), so
+        ``(rows, mask, stats)`` reconstructs every agent's normalized obs
+        exactly (pinned in tests/test_entity_tables.py). Must be called on
+        the post-``get_obs`` state (its ``norm`` already updated) — the
+        runner calls it on the state ``step``/``reset`` returned. Only
+        valid for ``obs_entity_mode`` + ``fast_norm`` (the sequential
+        normalizer gives each agent different prefix statistics)."""
+        assert self.cfg.obs_entity_mode and self.cfg.fast_norm
+        inf = self._agent_inf(state)
+        ack1h = self._ack_onehot(state.last_ack)
+        rows = jnp.concatenate([ack1h, inf], axis=1)             # (A, 8)
+        same_mec = state.mec_index[:, None] == state.mec_index[None, :]
+        a = self.n_agents
+        mean = state.norm.mean.reshape(a, self.obs_entity_feats)
+        std = state.norm.std.reshape(a, self.obs_entity_feats)
+        return rows, same_mec, mean, std
+
     def get_state(self, state: EnvState) -> jnp.ndarray:
         """Global state: all-agent ACK one-hots ++ all-agent agent_inf rows,
         flattened (reference ``get_state`` :188-204); not normalized. With

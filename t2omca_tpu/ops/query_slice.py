@@ -78,6 +78,18 @@ def agent_qslice_eligible(cfg) -> bool:
             and cfg.action_selector != "noisy-new")
 
 
+def entity_tables_eligible(cfg) -> bool:
+    """Entity-table acting eligibility: needs the qslice agent path, the
+    entity observation mode (the factored structure IS the entity obs), the
+    batched normalizer (the sequential one gives each observer different
+    prefix statistics), and no entity-count override (tables are derived
+    from the env's own agents)."""
+    return (agent_qslice_eligible(cfg)
+            and cfg.env_args.obs_entity_mode
+            and cfg.env_args.fast_norm
+            and cfg.model.n_entities_obs == 0)
+
+
 def mixer_qslice_eligible(cfg) -> bool:
     """Mixer-side eligibility: deterministic transformer mixer only (only
     the last ``n_agents+3`` output rows are consumed, models/mixer.py)."""
@@ -162,23 +174,30 @@ def transformer_rows(tf_folded: dict, k0: jnp.ndarray, x0: jnp.ndarray, *,
         ctx = ctx.astype(dtype).reshape(s * r, heads * emb)
         attended = (jnp.dot(ctx, wvu, preferred_element_type=jnp.float32)
                     + bp["u_bias"].astype(jnp.float32))         # (S·R, E) f32
-
-        # Q2 post-LN residuals, f32 statistics (ops/transformer_block.py)
-        x1 = _ln(attended + x0.reshape(s * r, emb).astype(jnp.float32),
-                 bp["n1"]["scale"].astype(jnp.float32),
-                 bp["n1"]["bias"].astype(jnp.float32))
-        hid = jnp.dot(x1.astype(dtype), bp["ff1"]["kernel"].astype(dtype),
-                      preferred_element_type=jnp.float32)
-        hid = jnp.maximum(hid + bp["ff1"]["bias"].astype(jnp.float32), 0.0)
-        y = jnp.dot(hid.astype(dtype), bp["ff2"]["kernel"].astype(dtype),
-                    preferred_element_type=jnp.float32)
-        y = y + bp["ff2"]["bias"].astype(jnp.float32)
-        x2 = _ln(y + x1,
-                 bp["n2"]["scale"].astype(jnp.float32),
-                 bp["n2"]["bias"].astype(jnp.float32))
-        x0 = x2.astype(dtype).reshape(s, r, emb)
+        x0 = _block_tail(bp, attended,
+                         x0.reshape(s * r, emb), dtype).reshape(s, r, emb)
 
     return x0.astype(jnp.float32)
+
+
+def _block_tail(bp: dict, attended: jnp.ndarray, x0_flat: jnp.ndarray,
+                dtype) -> jnp.ndarray:
+    """Post-attention block tail shared by both query-slice forwards:
+    Q2 post-LN residuals + FFN, f32 statistics (ops/transformer_block.py).
+    ``attended (N, E)`` f32, ``x0_flat (N, E)`` in compute dtype."""
+    x1 = _ln(attended + x0_flat.astype(jnp.float32),
+             bp["n1"]["scale"].astype(jnp.float32),
+             bp["n1"]["bias"].astype(jnp.float32))
+    hid = jnp.dot(x1.astype(dtype), bp["ff1"]["kernel"].astype(dtype),
+                  preferred_element_type=jnp.float32)
+    hid = jnp.maximum(hid + bp["ff1"]["bias"].astype(jnp.float32), 0.0)
+    y = jnp.dot(hid.astype(dtype), bp["ff2"]["kernel"].astype(dtype),
+                preferred_element_type=jnp.float32)
+    y = y + bp["ff2"]["bias"].astype(jnp.float32)
+    x2 = _ln(y + x1,
+             bp["n2"]["scale"].astype(jnp.float32),
+             bp["n2"]["bias"].astype(jnp.float32))
+    return x2.astype(dtype)
 
 
 def fold_agent_params(variables: dict, *, emb: int, heads: int, depth: int,
@@ -252,6 +271,106 @@ def make_mixer_qslice(mixer):
         state_entity_mode=mixer.state_entity_mode,
         standard_heads=mixer.standard_heads, dtype=mixer.dtype)
     return fold, apply
+
+
+def agent_forward_qslice_entity(variables: dict, rows: jnp.ndarray,
+                                same_mec: jnp.ndarray, mean: jnp.ndarray,
+                                std: jnp.ndarray, hidden_state: jnp.ndarray,
+                                *, emb: int, heads: int, depth: int,
+                                n_actions: int, standard_heads: bool = False,
+                                dtype=jnp.float32
+                                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Entity-table acting forward: ``agent_forward_qslice`` without ever
+    materializing per-agent token embeddings.
+
+    Exploits the structure of the entity observation
+    (``envs/mec_offload.py:_raw_obs`` + the shared ``fast_norm`` affine):
+    agent ``i``'s token ``j`` is ``(same_mec[i,j] ? rows[j] : 0, is_self)``
+    normalized by per-position statistics that are identical for every
+    observer — so each env has only TWO distinct embedded values per entity
+    (visible / masked) plus a diagonal is-self correction. Attention logits
+    and context therefore contract against per-env ``(A, E)`` tables instead
+    of per-agent ``(A, A+1, E)`` key tensors: at the north-star scale this
+    removes the 576→emb embedding matmul (~1.2 TFLOP/slot) AND the
+    ``(B·A, A+1, E)`` key materialization (~GBs/slot of HBM traffic) from
+    the acting path. Exact to float reassociation vs the obs-path forward
+    (pinned in tests/test_entity_tables.py).
+
+    Inputs per ``MultiAgvOffloadingEnv.compact_obs``: ``rows (B, A, 8)``,
+    ``same_mec (B, A, A)`` bool, ``mean/std (B, A, 9)``; ``hidden_state
+    (B, A, emb)``. Requires ``obs_entity_mode`` + ``fast_norm`` and no
+    ``n_entities`` override (gated by ``entity_tables_eligible``)."""
+    f = fold_agent_params(variables, emb=emb, heads=heads, depth=depth,
+                          standard_heads=standard_heads, dtype=dtype)
+    b, a, _ = rows.shape
+    s = b * a
+
+    # ---- per-env embedding tables (feat 8 = is_self; _raw_obs layout)
+    denom = std.astype(jnp.float32) + 1e-8                    # (B, A, 9)
+    rows9 = jnp.concatenate(
+        [rows.astype(jnp.float32), jnp.zeros((b, a, 1))], axis=-1)
+    nv = ((rows9 - mean) / denom).astype(dtype)               # visible row
+    nh = ((-mean) / denom).astype(dtype)                      # masked row
+    we = f["fe"]["kernel"].astype(dtype)                      # (9, E)
+    be = f["fe"]["bias"].astype(jnp.float32)
+    e_vis = (jnp.dot(nv, we, preferred_element_type=jnp.float32)
+             + be).astype(dtype)                              # (B, A, E)
+    e_hid = (jnp.dot(nh, we, preferred_element_type=jnp.float32)
+             + be).astype(dtype)
+    self_corr = (we[8][None, None, :].astype(jnp.float32)
+                 / denom[..., 8:9]).astype(dtype)             # (B, A, E)
+
+    h_tok = hidden_state.astype(dtype)                        # (B, A, E)
+    vis = same_mec[:, :, None, :]                             # (B, A, 1, A)
+    eye = jnp.eye(a, dtype=dtype)[None, :, None, :]           # (1, A, 1, A)
+    idx_diag = jnp.arange(a)[None, :, None, None]
+
+    x0 = h_tok
+    for i in range(depth):
+        bp = f["tf"]["blocks"][i]
+        qp = jnp.dot(x0.reshape(s, emb), bp["wqk"],
+                     preferred_element_type=jnp.float32)
+        qp = qp.astype(dtype).reshape(b, a, heads, emb)
+        # logits against key 0 (own hidden token) and the entity tables
+        l0 = jnp.einsum("bahe,bae->bah", qp, h_tok,
+                        preferred_element_type=jnp.float32)
+        lv = jnp.einsum("bahe,bje->bahj", qp, e_vis,
+                        preferred_element_type=jnp.float32)
+        lh = jnp.einsum("bahe,bje->bahj", qp, e_hid,
+                        preferred_element_type=jnp.float32)
+        ls = jnp.einsum("bahe,bae->bah", qp, self_corr,
+                        preferred_element_type=jnp.float32)
+        lent = jnp.where(vis, lv, lh) + eye.astype(jnp.float32) \
+            * ls[..., None]
+        logits = jnp.concatenate([l0[..., None], lent], axis=-1)
+        if dtype == jnp.float32:
+            attn = jax.nn.softmax(logits, axis=-1)
+        else:
+            attn = jax.nn.softmax(logits.astype(dtype), axis=-1)
+        attn = attn.astype(dtype)
+        a0, ae = attn[..., 0], attn[..., 1:]                  # (B,A,H[,A])
+        av = ae * vis.astype(dtype)
+        ah = ae - av                                          # masked branch
+        diag = jnp.take_along_axis(ae, idx_diag, axis=-1)[..., 0]
+        ctx = (a0[..., None] * h_tok[:, :, None, :]
+               + jnp.einsum("bahj,bje->bahe", av, e_vis,
+                            preferred_element_type=jnp.float32).astype(dtype)
+               + jnp.einsum("bahj,bje->bahe", ah, e_hid,
+                            preferred_element_type=jnp.float32).astype(dtype)
+               + diag[..., None] * self_corr[:, :, None, :])
+        ctx = ctx.astype(dtype).reshape(s, heads * emb)
+        attended = (jnp.dot(ctx, bp["wvu"],
+                            preferred_element_type=jnp.float32)
+                    + bp["u_bias"].astype(jnp.float32))
+        x0 = _block_tail(bp, attended, x0.reshape(s, emb), dtype) \
+            .reshape(b, a, emb)
+
+    h_new = x0.astype(jnp.float32).reshape(s, emb)
+    qb = f["qb"]
+    q = (jnp.dot(h_new, qb["kernel"].astype(jnp.float32))
+         + qb["bias"].astype(jnp.float32))
+    return (q.reshape(b, a, n_actions),
+            h_new.reshape(b, a, emb))
 
 
 def fold_mixer_params(variables: dict, *, emb: int, heads: int, depth: int,

@@ -131,9 +131,15 @@ class ParallelRunner:
         def step_fn(carry, key_t):
             env_states, obs, gstate, avail, hidden, t_env = carry
             k_act, k_env = jax.random.split(key_t)
+            # entity-table acting: the factored obs is a pure function of
+            # the carried env state (same post-update norm stats the carried
+            # obs was normalized with), so recompute it here instead of
+            # widening the carry
+            compact = (jax.vmap(self.env.compact_obs)(env_states)
+                       if self.mac.use_entity_tables else None)
             actions, hidden, eps = self.mac.select_actions(
                 params, obs, avail, hidden, k_act, t_env,
-                test_mode=test_mode)
+                test_mode=test_mode, compact=compact)
             # Q15: the action is recorded with the pre-step observation.
             # Cast to the storage dtype here so the scan stacks the compact
             # representation (the f32 episode stack is the HBM hot spot);

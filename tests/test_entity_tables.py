@@ -1,0 +1,166 @@
+"""Exactness of the entity-table acting path (ops/query_slice,
+``agent_forward_qslice_entity``) against the obs-based query-slice forward.
+
+The factored form must reproduce the full normalized entity observation's
+embeddings (visible/masked tables + is-self diagonal) and hence identical
+Q-values — on REAL env states (including the post-reset first-sample
+statistics and mid-episode Welford states), not just synthetic inputs."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from t2omca_tpu.config import EnvConfig, ModelConfig, TrainConfig, sanity_check
+from t2omca_tpu.controllers.basic_mac import BasicMAC
+from t2omca_tpu.envs.mec_offload import MultiAgvOffloadingEnv
+from t2omca_tpu.run import Experiment
+
+
+def _cfg(**model_kw):
+    return sanity_check(TrainConfig(
+        batch_size_run=4,
+        env_args=EnvConfig(agv_num=5, mec_num=2, num_channels=3,
+                           episode_limit=6, fast_norm=True),
+        model=ModelConfig(emb=16, heads=2, depth=2, mixer_emb=16,
+                          mixer_heads=2, mixer_depth=2, **model_kw),
+    ))
+
+
+def _rolled_states(env, b, steps, key):
+    """Env states after ``steps`` random steps (real queues + norm stats)."""
+    states, obs, *_ = jax.vmap(env.reset)(jax.random.split(key, b))
+    for t in range(steps):
+        k = jax.random.fold_in(key, 100 + t)
+        actions = jax.random.randint(k, (b, env.n_agents), 0, env.n_actions)
+        actions = actions * states.job_valid[:, :, 0]
+        states, _, _, _, obs, *_ = jax.vmap(env.step)(
+            states, actions, jax.random.split(k, b))
+    return states, obs
+
+
+@pytest.mark.parametrize("steps", [0, 4])
+@pytest.mark.parametrize("standard_heads", [False, True])
+def test_entity_forward_matches_obs_forward(steps, standard_heads):
+    cfg = _cfg(standard_heads=standard_heads)
+    exp = Experiment.build(cfg)
+    env, mac = exp.env, exp.mac
+    assert mac.use_entity_tables
+
+    b = cfg.batch_size_run
+    key = jax.random.PRNGKey(steps)
+    states, obs = _rolled_states(env, b, steps, key)
+    compact = jax.vmap(env.compact_obs)(states)
+
+    params = mac.init_params(key, env.obs_dim)
+    hidden = jax.random.normal(jax.random.fold_in(key, 1),
+                               (b, env.n_agents, cfg.model.emb))
+
+    q_obs, h_obs = mac.forward_qslice(params, obs, hidden)
+    q_ent, h_ent = mac.forward_entity(params, compact, hidden)
+    np.testing.assert_allclose(q_ent, q_obs, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(h_ent, h_obs, rtol=2e-4, atol=2e-5)
+
+
+def test_entity_forward_matches_dense_flax():
+    """Transitively exact vs the dense module too."""
+    cfg = _cfg()
+    exp = Experiment.build(cfg)
+    env, mac = exp.env, exp.mac
+    b = cfg.batch_size_run
+    key = jax.random.PRNGKey(7)
+    states, obs = _rolled_states(env, b, 3, key)
+    compact = jax.vmap(env.compact_obs)(states)
+    params = mac.init_params(key, env.obs_dim)
+    hidden = jnp.zeros((b, env.n_agents, cfg.model.emb))
+
+    q_dense, h_dense = mac.forward(params, obs, hidden)
+    q_ent, h_ent = mac.forward_entity(params, compact, hidden)
+    np.testing.assert_allclose(q_ent, q_dense, rtol=5e-4, atol=5e-5)
+    np.testing.assert_allclose(h_ent, h_dense, rtol=5e-4, atol=5e-5)
+
+
+def test_entity_forward_bf16_matches_obs_forward():
+    """The production bench config (bfloat16 + standard heads + fast_norm)
+    runs exactly this path — pin its numerics too."""
+    cfg = _cfg(standard_heads=True, dtype="bfloat16")
+    exp = Experiment.build(cfg)
+    env, mac = exp.env, exp.mac
+    assert mac.use_entity_tables
+    b = cfg.batch_size_run
+    key = jax.random.PRNGKey(3)
+    states, obs = _rolled_states(env, b, 3, key)
+    compact = jax.vmap(env.compact_obs)(states)
+    params = mac.init_params(key, env.obs_dim)
+    hidden = jnp.zeros((b, env.n_agents, cfg.model.emb))
+    q_obs, h_obs = mac.forward_qslice(params, obs, hidden)
+    q_ent, h_ent = mac.forward_entity(params, compact, hidden)
+    np.testing.assert_allclose(q_ent, q_obs, rtol=0.05, atol=0.05)
+    np.testing.assert_allclose(h_ent, h_obs, rtol=0.05, atol=0.05)
+
+
+def test_rollout_actions_match_obs_path():
+    """Greedy episode through the runner: entity-table acting and obs-path
+    acting pick identical actions and returns."""
+    cfg = _cfg()
+    exp_ent = Experiment.build(cfg)
+    cfg_obs = cfg.replace(
+        model=dataclasses.replace(cfg.model, use_entity_tables=False))
+    exp_obs = Experiment.build(cfg_obs)
+    assert exp_ent.mac.use_entity_tables
+    assert not exp_obs.mac.use_entity_tables
+
+    ts = exp_ent.init_train_state(0)
+    run_ent = jax.jit(exp_ent.runner.run, static_argnames="test_mode")
+    run_obs = jax.jit(exp_obs.runner.run, static_argnames="test_mode")
+    p = ts.learner.params["agent"]
+    _, b_ent, s_ent = run_ent(p, ts.runner, test_mode=True)
+    _, b_obs, s_obs = run_obs(p, ts.runner, test_mode=True)
+    np.testing.assert_array_equal(b_ent.actions, b_obs.actions)
+    np.testing.assert_allclose(s_ent.episode_return, s_obs.episode_return,
+                               rtol=1e-5)
+
+
+def test_eligibility_gating():
+    env_info_keys = None  # Experiment.build derives env_info itself
+    # sequential normalizer → tables ineligible (per-observer prefix stats)
+    cfg = sanity_check(TrainConfig(
+        env_args=EnvConfig(agv_num=4, mec_num=2, episode_limit=5,
+                           fast_norm=False),
+        model=ModelConfig(emb=16, heads=2, depth=1, mixer_emb=16,
+                          mixer_heads=2)))
+    assert not Experiment.build(cfg).mac.use_entity_tables
+
+    # flat obs mode → ineligible
+    cfg2 = sanity_check(TrainConfig(
+        env_args=EnvConfig(agv_num=4, mec_num=2, episode_limit=5,
+                           obs_entity_mode=False, fast_norm=True),
+        model=ModelConfig(emb=16, heads=2, depth=1, mixer_emb=16,
+                          mixer_heads=2)))
+    assert not Experiment.build(cfg2).mac.use_entity_tables
+
+    # eligible default
+    cfg3 = _cfg()
+    mac3 = Experiment.build(cfg3).mac
+    assert mac3.use_entity_tables and mac3.use_qslice
+
+
+def test_compact_obs_reconstructs_full_obs():
+    """(rows, mask, stats) → the exact normalized obs the env returned."""
+    cfg = _cfg()
+    env = Experiment.build(cfg).env
+    b = 3
+    key = jax.random.PRNGKey(11)
+    states, obs = _rolled_states(env, b, 5, key)
+    rows, same_mec, mean, std = jax.vmap(env.compact_obs)(states)
+
+    a, f = env.n_agents, env.obs_entity_feats
+    rows9 = jnp.concatenate([rows, jnp.zeros((b, a, 1))], axis=-1)
+    raw = jnp.where(same_mec[:, :, :, None], rows9[:, None, :, :], 0.0)
+    raw = raw.at[:, jnp.arange(a), jnp.arange(a), f - 1].set(1.0)
+    denom = std + 1e-8
+    norm = (raw - mean[:, None]) / denom[:, None]
+    np.testing.assert_allclose(norm.reshape(b, a, a * f), obs,
+                               rtol=1e-5, atol=1e-6)
