@@ -38,10 +38,26 @@ from flax import struct
 
 
 @struct.dataclass
+class CompactEntityObs:
+    """Factored entity observation (``env.compact_obs``) as episode storage:
+    ~``obs_dim/(rows+stats)`` ≈ 20× smaller than the flattened ``(A, A·F)``
+    obs it reconstructs exactly (same-MEC visibility × shared per-position
+    normalization affine; ops/query_slice.agent_forward_qslice_entity
+    consumes it directly, tests/test_entity_tables.py pins the
+    reconstruction)."""
+
+    rows: jnp.ndarray       # (B, T+1, A, F-1) — raw entity feature rows
+    mec_index: jnp.ndarray  # (B, T+1, A) int8 — visibility = same-MEC
+    mean: jnp.ndarray       # (B, T+1, A, F) f32 — per-position Welford mean
+    std: jnp.ndarray        # (B, T+1, A, F) f32
+
+
+@struct.dataclass
 class EpisodeBatch:
     """One (batch of) episode(s): arrays shaped ``(B, T(+1), ...)``."""
 
-    obs: jnp.ndarray            # (B, T+1, A, obs_dim) float32
+    obs: jnp.ndarray            # (B, T+1, A, obs_dim) float32 — or a
+                                # CompactEntityObs pytree (compact storage)
     state: jnp.ndarray          # (B, T+1, state_dim) float32
     avail_actions: jnp.ndarray  # (B, T+1, A, n_actions) int8 (storage; all
                                 # consumers only compare > 0)
@@ -52,7 +68,7 @@ class EpisodeBatch:
 
     @property
     def batch_size(self) -> int:
-        return self.obs.shape[0]
+        return jax.tree.leaves(self.obs)[0].shape[0]
 
     @property
     def max_seq_length(self) -> int:
@@ -78,9 +94,23 @@ class BufferState:
 
 def _zeros_like_episode(n_agents: int, n_actions: int, obs_dim: int,
                         state_dim: int, t: int, batch: int,
-                        store_dtype=jnp.float32) -> EpisodeBatch:
+                        store_dtype=jnp.float32,
+                        compact_obs: bool = False) -> EpisodeBatch:
+    if compact_obs:
+        f = obs_dim // n_agents        # entity feats (entity-mode layout)
+        # compact leaves stay f32 regardless of store_dtype: raw features
+        # + statistics, where bf16 error would be amplified by the
+        # learner's re-normalization (see ParallelRunner.obs_store)
+        obs = CompactEntityObs(
+            rows=jnp.zeros((batch, t + 1, n_agents, f - 1), jnp.float32),
+            mec_index=jnp.zeros((batch, t + 1, n_agents), jnp.int8),
+            mean=jnp.zeros((batch, t + 1, n_agents, f), jnp.float32),
+            std=jnp.zeros((batch, t + 1, n_agents, f), jnp.float32),
+        )
+    else:
+        obs = jnp.zeros((batch, t + 1, n_agents, obs_dim), store_dtype)
     return EpisodeBatch(
-        obs=jnp.zeros((batch, t + 1, n_agents, obs_dim), store_dtype),
+        obs=obs,
         state=jnp.zeros((batch, t + 1, state_dim), store_dtype),
         avail_actions=jnp.zeros((batch, t + 1, n_agents, n_actions), jnp.int8),
         actions=jnp.zeros((batch, t, n_agents), jnp.int32),
@@ -102,6 +132,7 @@ class ReplayBuffer:
     obs_dim: int
     state_dim: int
     store_dtype: str = "float32"   # obs/state storage dtype (HBM budget)
+    compact_obs: bool = False      # CompactEntityObs storage (entity mode)
 
     def __post_init__(self):
         if self.capacity < 1:
@@ -113,7 +144,7 @@ class ReplayBuffer:
             storage=_zeros_like_episode(
                 self.n_agents, self.n_actions, self.obs_dim, self.state_dim,
                 self.episode_limit, self.capacity,
-                jnp.dtype(self.store_dtype)),
+                jnp.dtype(self.store_dtype), compact_obs=self.compact_obs),
             insert_pos=jnp.zeros((), jnp.int32),
             episodes_in_buffer=jnp.zeros((), jnp.int32),
             priorities=jnp.zeros((self.capacity,), jnp.float32),

@@ -98,6 +98,11 @@ class ReplayConfig:
     # replay ring (HBM is the budget; bf16 halves it — the TPU analog of the
     # reference's buffer_cpu_only escape hatch)
     store_dtype: str = "float32"          # float32 | bfloat16
+    # store the factored entity obs (rows + MEC index + normalizer stats,
+    # ~20x smaller, exact reconstruction) instead of the flattened entity
+    # obs; auto-disabled where inapplicable (ops/query_slice.py
+    # entity_store_eligible)
+    compact_entity_store: bool = True
 
 
 @dataclass(frozen=True)

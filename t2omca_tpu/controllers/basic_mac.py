@@ -97,8 +97,7 @@ class BasicMAC:
                    pallas_interpret=jax.default_backend() == "cpu",
                    pallas_tile=cfg.model.pallas_tile,
                    use_qslice=use_qslice,
-                   use_entity_tables=(cfg.model.use_entity_tables
-                                      and use_qslice
+                   use_entity_tables=(use_qslice
                                       and entity_tables_eligible(cfg)))
 
     # ------------------------------------------------------------------ state

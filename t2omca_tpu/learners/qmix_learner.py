@@ -147,12 +147,32 @@ class QMixLearner:
                 or self.cfg.model.dropout > 0.0)
 
     def _unroll_agent(self, agent_params, obs_tm: jnp.ndarray,
-                      key: Optional[jax.Array] = None
-                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                      key: Optional[jax.Array] = None,
+                      compact_tm=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """obs_tm ``(T1, B, A, O)`` → (q ``(T1, B, A, n_actions)``,
         hiddens ``(T1, B, A, emb)``); carries the recurrent hidden token.
         ``key`` (when the config is noisy / has dropout) drives per-step
-        noise resampling, matching a fresh draw per forward."""
+        noise resampling, matching a fresh draw per forward. With
+        ``compact_tm`` (time-major ``(rows, same_mec, mean, std)`` from
+        compact entity storage) the unroll runs the entity-table forward —
+        same function, ~20× less input data (obs_tm may be None)."""
+        if compact_tm is not None:
+            assert key is None   # compact storage gated to the pure path
+            b = compact_tm[0].shape[1]
+            from ..ops.query_slice import fold_agent_params
+            a = self.mac.agent
+            agent_params = fold_agent_params(
+                agent_params, emb=a.emb, heads=a.heads, depth=a.depth,
+                standard_heads=a.standard_heads, dtype=a.dtype)
+
+            def body(h, xs):
+                q, h = self.mac.forward_entity(agent_params, xs, h)
+                return h, (q, h)
+
+            _, (qs, hs) = jax.lax.scan(
+                body, self.mac.init_hidden(b), compact_tm)
+            return qs, hs
+
         b = obs_tm.shape[1]
 
         if key is None:
@@ -235,8 +255,24 @@ class QMixLearner:
               ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
         cfg = self.cfg
         # time-major views; obs/state may be stored bf16 (ReplayConfig
-        # store_dtype) — lift back to f32 for the loss math
-        obs = jnp.swapaxes(batch.obs, 0, 1).astype(jnp.float32)
+        # store_dtype) — lift back to f32 for the loss math. Compact entity
+        # storage (CompactEntityObs) unrolls through the entity-table
+        # forward instead of reconstructing the flat obs (the mixer never
+        # reads obs in state_entity_mode, which the storage gate requires).
+        from ..components.episode_buffer import CompactEntityObs
+        if isinstance(batch.obs, CompactEntityObs):
+            co = batch.obs
+            mec = jnp.swapaxes(co.mec_index, 0, 1)
+            compact_tm = (
+                jnp.swapaxes(co.rows, 0, 1).astype(jnp.float32),
+                mec[..., :, None] == mec[..., None, :],
+                jnp.swapaxes(co.mean, 0, 1),
+                jnp.swapaxes(co.std, 0, 1),
+            )
+            obs = None
+        else:
+            compact_tm = None
+            obs = jnp.swapaxes(batch.obs, 0, 1).astype(jnp.float32)
         state = jnp.swapaxes(batch.state, 0, 1).astype(jnp.float32)
         avail = jnp.swapaxes(batch.avail_actions, 0, 1)   # (T+1, B, A, n)
         actions = jnp.swapaxes(batch.actions, 0, 1)       # (T, B, A)
@@ -255,9 +291,10 @@ class QMixLearner:
         # both into one stacked scan would re-attach the target lane to the
         # VJP (zero cotangents still cost full backward matmuls + 2x scan
         # residual memory), trading a halved forward for a heavier backward
-        qs, hs = self._unroll_agent(params["agent"], obs, k_ag)
+        qs, hs = self._unroll_agent(params["agent"], obs, k_ag,
+                                    compact_tm=compact_tm)
         target_qs, target_hs = self._unroll_agent(
-            target_params["agent"], obs, k_tag)
+            target_params["agent"], obs, k_tag, compact_tm=compact_tm)
 
         chosen = jnp.take_along_axis(
             qs[:-1], actions[..., None], axis=-1)[..., 0]  # (T, B, A)
@@ -275,15 +312,17 @@ class QMixLearner:
             target_max = jnp.where(
                 avail > 0, target_qs, -jnp.inf).max(axis=-1)
 
+        obs_m = None if obs is None else obs[:-1]
         q_tot = self._unroll_mixer(
-            params["mixer"], chosen, hs[:-1], state[:-1], obs[:-1], k_mx)
+            params["mixer"], chosen, hs[:-1], state[:-1], obs_m, k_mx)
         # target unroll spans t=0..T (recurrence semantics of
         # /root/reference/n_transf_mixer.py:55,91: both nets start their
         # hyper recurrence at the episode start); outputs [1:] are the
         # bootstrap values
         target_q_tot = self._unroll_mixer(
             target_params["mixer"], target_max, target_hs, state,
-            obs, k_tmx)[1:]
+            obs, k_tmx)[1:]   # obs may be None (compact storage: the
+        # state-entity mixer never reads it)
 
         targets = reward + cfg.gamma * (1.0 - term) * target_q_tot
         td = (q_tot - jax.lax.stop_gradient(targets)) * mask

@@ -79,15 +79,31 @@ def agent_qslice_eligible(cfg) -> bool:
 
 
 def entity_tables_eligible(cfg) -> bool:
-    """Entity-table acting eligibility: needs the qslice agent path, the
-    entity observation mode (the factored structure IS the entity obs), the
-    batched normalizer (the sequential one gives each observer different
-    prefix statistics), and no entity-count override (tables are derived
-    from the env's own agents)."""
-    return (agent_qslice_eligible(cfg)
+    """Entity-table eligibility: needs the ``use_entity_tables`` kill
+    switch on (it covers BOTH acting and the learner's compact-storage
+    unroll), the qslice agent path, the entity observation mode (the
+    factored structure IS the entity obs), the batched normalizer (the
+    sequential one gives each observer different prefix statistics), and
+    no entity-count override (tables are derived from the env's own
+    agents)."""
+    return (cfg.model.use_entity_tables
+            and agent_qslice_eligible(cfg)
             and cfg.env_args.obs_entity_mode
             and cfg.env_args.fast_norm
             and cfg.model.n_entities_obs == 0)
+
+
+def entity_store_eligible(cfg) -> bool:
+    """Compact entity episode STORAGE eligibility: on top of the acting
+    eligibility, the learner must be able to unroll through the entity
+    forward (deterministic transformer — already implied) and the mixer
+    must not consume stored obs (Q12 fallback needs the full tensor), and
+    the host-RAM buffer keeps the plain layout (its escape-hatch use case
+    predates the 20× shrink)."""
+    return (cfg.replay.compact_entity_store
+            and entity_tables_eligible(cfg)
+            and cfg.env_args.state_entity_mode
+            and not cfg.replay.buffer_cpu_only)
 
 
 def mixer_qslice_eligible(cfg) -> bool:

@@ -73,6 +73,7 @@ class Experiment:
         learner = LEARNER_REGISTRY[cfg.learner].build(cfg, mac, env_info)
         runner_cls = RUNNER_REGISTRY[cfg.runner]
         runner = runner_cls(env, mac, cfg)
+        from .ops.query_slice import entity_store_eligible
         buf_kw = dict(
             capacity=cfg.replay.buffer_size,
             episode_limit=cfg.env_args.episode_limit,
@@ -82,6 +83,8 @@ class Experiment:
             state_dim=env_info["state_shape"],
             store_dtype=cfg.replay.store_dtype,
         )
+        if not cfg.replay.buffer_cpu_only:
+            buf_kw["compact_obs"] = entity_store_eligible(cfg)
         if cfg.replay.buffer_cpu_only:
             # host-RAM replay with the native sum-tree (reference
             # buffer_cpu_only semantics: storage on CPU, samples to device)

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from ..components.episode_buffer import EpisodeBatch
+from ..components.episode_buffer import CompactEntityObs, EpisodeBatch
 from ..config import TrainConfig
 from ..controllers.basic_mac import BasicMAC
 from ..envs.mec_offload import EnvState, MultiAgvOffloadingEnv
@@ -86,6 +86,13 @@ class ParallelRunner:
     def batch_size(self) -> int:
         return self.cfg.batch_size_run
 
+    @property
+    def compact_store(self) -> bool:
+        """Store the factored entity obs instead of the flattened tensor
+        (ops/query_slice.entity_store_eligible)."""
+        from ..ops.query_slice import entity_store_eligible
+        return entity_store_eligible(self.cfg)
+
     def get_env_info(self) -> Dict[str, int]:
         return self.env.get_env_info()
 
@@ -128,15 +135,34 @@ class ParallelRunner:
 
         hidden = self.mac.init_hidden(b)
 
+        compact_store = self.compact_store
+        sd = jnp.dtype(self.cfg.replay.store_dtype)
+
+        def obs_store(env_states, obs, compact):
+            """Pre-step observation in its storage form (Q15 slot). Compact
+            leaves stay f32 even under store_dtype=bf16: they are raw
+            UN-normalized features (O(1e4) data sizes), where bf16 error is
+            amplified ~|mean|/std by the learner's re-normalization — and
+            at ~1/20th the footprint of the dense obs there is nothing
+            worth saving."""
+            if not compact_store:
+                return obs.astype(sd)
+            rows, _, mean, std = compact
+            return CompactEntityObs(
+                rows=rows,
+                mec_index=env_states.mec_index.astype(jnp.int8),
+                mean=mean, std=std)
+
         def step_fn(carry, key_t):
             env_states, obs, gstate, avail, hidden, t_env = carry
             k_act, k_env = jax.random.split(key_t)
-            # entity-table acting: the factored obs is a pure function of
-            # the carried env state (same post-update norm stats the carried
-            # obs was normalized with), so recompute it here instead of
-            # widening the carry
+            # entity-table acting / compact storage: the factored obs is a
+            # pure function of the carried env state (same post-update norm
+            # stats the carried obs was normalized with), so recompute it
+            # here instead of widening the carry
             compact = (jax.vmap(self.env.compact_obs)(env_states)
-                       if self.mac.use_entity_tables else None)
+                       if self.mac.use_entity_tables or compact_store
+                       else None)
             actions, hidden, eps = self.mac.select_actions(
                 params, obs, avail, hidden, k_act, t_env,
                 test_mode=test_mode, compact=compact)
@@ -144,8 +170,7 @@ class ParallelRunner:
             # Cast to the storage dtype here so the scan stacks the compact
             # representation (the f32 episode stack is the HBM hot spot);
             # avail narrows to int8 — every consumer only compares > 0
-            sd = jnp.dtype(self.cfg.replay.store_dtype)
-            pre = (obs.astype(sd), gstate.astype(sd),
+            pre = (obs_store(env_states, obs, compact), gstate.astype(sd),
                    avail.astype(jnp.int8), actions)
             viz = ((env_states.pos, env_states.mec_index)
                    if capture else None)
@@ -166,12 +191,18 @@ class ParallelRunner:
 
         # (T, B, ...) → (B, T, ...), with the bootstrap step appended
         bt = lambda x: jnp.swapaxes(x, 0, 1)
-        cat_last = lambda seq, last: jnp.concatenate(
-            [bt(seq), last[:, None]], axis=1)
+        cat_last = lambda seq, last: jax.tree.map(
+            lambda s, l: jnp.concatenate([bt(s), l[:, None]], axis=1),
+            seq, last)
 
-        sd = jnp.dtype(self.cfg.replay.store_dtype)
+        if compact_store:
+            last_obs_store = obs_store(
+                env_states, last_obs,
+                jax.vmap(self.env.compact_obs)(env_states))
+        else:
+            last_obs_store = last_obs.astype(sd)
         batch = EpisodeBatch(
-            obs=cat_last(obs_seq, last_obs.astype(sd)),
+            obs=cat_last(obs_seq, last_obs_store),
             state=cat_last(gstate_seq, last_gstate.astype(sd)),
             avail_actions=cat_last(avail_seq, last_avail.astype(jnp.int8)),
             actions=bt(action_seq),

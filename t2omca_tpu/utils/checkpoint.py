@@ -52,4 +52,13 @@ def find_checkpoint(path: str, load_step: int = 0) -> Optional[Tuple[str, int]]:
 def load_checkpoint(dirname: str, target: Any) -> Any:
     """Restore into a template pytree of the same structure."""
     with open(os.path.join(dirname, "state.msgpack"), "rb") as f:
-        return serialization.from_bytes(target, f.read())
+        data = f.read()
+    try:
+        return serialization.from_bytes(target, data)
+    except (KeyError, ValueError) as e:
+        raise ValueError(
+            f"checkpoint {dirname} does not match the configured train-state "
+            f"structure: {e}. A common cause is the replay storage layout — "
+            f"checkpoints written before/after the compact entity storage "
+            f"default need replay.compact_entity_store toggled to match "
+            f"(docs/SPEC.md perf modes)") from e

@@ -124,7 +124,6 @@ def test_rollout_actions_match_obs_path():
 
 
 def test_eligibility_gating():
-    env_info_keys = None  # Experiment.build derives env_info itself
     # sequential normalizer → tables ineligible (per-observer prefix stats)
     cfg = sanity_check(TrainConfig(
         env_args=EnvConfig(agv_num=4, mec_num=2, episode_limit=5,
@@ -145,6 +144,65 @@ def test_eligibility_gating():
     cfg3 = _cfg()
     mac3 = Experiment.build(cfg3).mac
     assert mac3.use_entity_tables and mac3.use_qslice
+
+
+def test_compact_store_train_matches_full_store():
+    """Rollout → insert → PER sample → train with compact entity storage
+    produces the same loss/priorities as full-obs storage (the stored
+    representation is exact, so the whole training step must agree)."""
+    import jax.numpy as jnp
+
+    def build(compact):
+        cfg = _cfg()
+        cfg = cfg.replace(batch_size=4, replay=dataclasses.replace(
+            cfg.replay, buffer_size=8, prioritized=True,
+            compact_entity_store=compact))
+        return Experiment.build(cfg)
+
+    exp_c, exp_f = build(True), build(False)
+    assert exp_c.buffer.compact_obs and not exp_f.buffer.compact_obs
+
+    losses = {}
+    for name, exp in (("compact", exp_c), ("full", exp_f)):
+        ts = exp.init_train_state(0)
+        rollout, insert, train_iter = exp.jitted_programs()
+        rs, batch, _ = rollout(ts.learner.params["agent"], ts.runner,
+                               test_mode=False)
+        ts = ts.replace(runner=rs, buffer=insert(ts.buffer, batch),
+                        episode=jnp.asarray(4, jnp.int32))
+        _, info = train_iter(ts, jax.random.PRNGKey(5), jnp.asarray(100))
+        losses[name] = (float(info["loss"]),
+                        jax.device_get(info["td_errors_abs"]))
+    np.testing.assert_allclose(losses["compact"][0], losses["full"][0],
+                               rtol=1e-4)
+    np.testing.assert_allclose(losses["compact"][1], losses["full"][1],
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_compact_store_driver_e2e(tmp_path):
+    """Full run() through compact storage: trains, checkpoints (the buffer
+    pytree now nests CompactEntityObs), resumes."""
+    from t2omca_tpu.run import run as run_driver
+
+    cfg = _cfg()
+    cfg = cfg.replace(
+        t_max=40, batch_size=2, test_interval=1000, log_interval=1000,
+        save_model=True, save_model_interval=10,
+        local_results_path=str(tmp_path),
+        replay=dataclasses.replace(cfg.replay, buffer_size=8))
+    from t2omca_tpu.ops.query_slice import entity_store_eligible
+    assert entity_store_eligible(cfg)
+    ts = run_driver(cfg)
+    assert float(jax.tree.leaves(ts.learner.params)[0].sum()) == \
+        float(jax.tree.leaves(ts.learner.params)[0].sum())  # finite/no nan
+
+    import glob as g
+    ckpts = g.glob(str(tmp_path) + "/models/*/*")
+    assert ckpts, "driver saved no checkpoint under compact storage"
+    cfg2 = cfg.replace(checkpoint_path=str(
+        sorted(ckpts)[0].rsplit("/", 1)[0]))
+    ts2 = run_driver(cfg2)   # resumes from the saved step and finishes
+    assert int(ts2.runner.t_env) >= 40
 
 
 def test_compact_obs_reconstructs_full_obs():
