@@ -50,7 +50,9 @@ from flax import struct
 from ..components.transforms import one_hot
 from ..config import EnvConfig
 from .critic import critic
-from .normalization import NormState, normalize, normalize_batch
+from .normalization import (NormState, apply_norm, normalize,
+                            normalize_batch, select_update,
+                            welford_update_batch_factored)
 
 
 def _round(x: jnp.ndarray, decimals: int = 0) -> jnp.ndarray:
@@ -216,19 +218,27 @@ class MultiAgvOffloadingEnv:
 
     # ------------------------------------------------------------------ obs/state
 
-    def _raw_obs(self, state: EnvState) -> jnp.ndarray:
-        """(A, obs_dim) pre-normalization observations."""
+    def _entity_parts(self, state: EnvState
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Factored entity obs pieces: feature ``rows (A, 8)`` and the
+        ``same_mec (A, A)`` visibility mask."""
         inf = self._agent_inf(state)
         ack1h = self._ack_onehot(state.last_ack)
+        rows = jnp.concatenate([ack1h, inf], axis=1)               # (A, 8)
+        same_mec = state.mec_index[:, None] == state.mec_index[None, :]
+        return rows, same_mec
+
+    def _raw_obs(self, state: EnvState) -> jnp.ndarray:
+        """(A, obs_dim) pre-normalization observations."""
         if self.cfg.obs_entity_mode:
             a = self.n_agents
-            rows = jnp.concatenate([ack1h, inf], axis=1)           # (A, 8)
-            same_mec = state.mec_index[:, None] == state.mec_index[None, :]
+            rows, same_mec = self._entity_parts(state)
             ent = jnp.where(same_mec[:, :, None],
                             jnp.broadcast_to(rows[None], (a, a, 8)), 0.0)
             is_self = jnp.eye(a)[:, :, None]       # diagonal is always same-MEC
             ent = jnp.concatenate([ent, is_self], axis=2)          # (A, A, 9)
             return ent.reshape(a, a * self.obs_entity_feats)
+        inf = self._agent_inf(state)
         return jnp.concatenate(
             [state.last_ack[:, None].astype(jnp.float32), inf], axis=1)
 
@@ -242,6 +252,21 @@ class MultiAgvOffloadingEnv:
         A-step sequential scan (the env-step serialization bottleneck at 64
         agents) becomes one order-free batched merge; equivalence-tolerance
         test in ``tests/test_normalization.py``."""
+        if self.cfg.fast_norm and self.cfg.obs_entity_mode:
+            # statistics from the FACTORED form (O(A·F), exact up to
+            # reassociation — normalization.welford_update_batch_factored);
+            # the normalized obs tensor is still produced from the
+            # materialized raw matrix, but when no consumer reads it (the
+            # entity-table acting + compact-storage stack) XLA dead-code
+            # eliminates the whole O(A²) materialization from the rollout
+            rows, same_mec = self._entity_parts(state)
+            norm = select_update(
+                state.norm,
+                welford_update_batch_factored(state.norm, rows, same_mec),
+                update_norm)
+            obs = apply_norm(norm, self._raw_obs(state))
+            return state.replace(norm=norm), obs
+
         raw = self._raw_obs(state)
 
         if self.cfg.fast_norm:
@@ -273,10 +298,7 @@ class MultiAgvOffloadingEnv:
         valid for ``obs_entity_mode`` + ``fast_norm`` (the sequential
         normalizer gives each agent different prefix statistics)."""
         assert self.cfg.obs_entity_mode and self.cfg.fast_norm
-        inf = self._agent_inf(state)
-        ack1h = self._ack_onehot(state.last_ack)
-        rows = jnp.concatenate([ack1h, inf], axis=1)             # (A, 8)
-        same_mec = state.mec_index[:, None] == state.mec_index[None, :]
+        rows, same_mec = self._entity_parts(state)
         a = self.n_agents
         mean = state.norm.mean.reshape(a, self.obs_entity_feats)
         std = state.norm.std.reshape(a, self.obs_entity_feats)

@@ -56,15 +56,8 @@ def normalize(state: NormState, x: jnp.ndarray,
     update, then normalize with the (post-update) statistics. ``update`` may
     be a Python bool or a traced scalar bool (so evaluation rollouts can flip
     it inside one jitted program)."""
-    if isinstance(update, bool):
-        if update:
-            state = welford_update(state, x)
-    else:
-        updated = welford_update(state, x)
-        u = jnp.asarray(update)
-        state = jax.tree.map(lambda a, b: jnp.where(u, a, b), updated, state)
-    y = (x - state.mean) / (state.std + 1e-8)
-    return state, y
+    state = select_update(state, welford_update(state, x), update)
+    return state, apply_norm(state, x)
 
 
 def welford_update_batch(state: NormState, xs: jnp.ndarray) -> NormState:
@@ -87,6 +80,13 @@ def welford_update_batch(state: NormState, xs: jnp.ndarray) -> NormState:
     a = xs.shape[0]
     bmean = xs.mean(axis=0)
     bs = ((xs - bmean) ** 2).sum(axis=0)
+    return _welford_merge(state, a, bmean, bs)
+
+
+def _welford_merge(state: NormState, a: int, bmean: jnp.ndarray,
+                   bs: jnp.ndarray) -> NormState:
+    """Chan-style merge of precomputed batch statistics (count ``a``,
+    mean ``bmean``, sum of squared deviations ``bs``)."""
     n1 = state.n + jnp.asarray(a, state.n.dtype)
     # correction terms in f32: the int32 product n·A would wrap after
     # ~2^31/A samples and poison the variance with NaNs
@@ -101,19 +101,59 @@ def welford_update_batch(state: NormState, xs: jnp.ndarray) -> NormState:
     return NormState(n=n1, mean=new_mean, s=new_s, std=new_std)
 
 
+def welford_update_batch_factored(state: NormState, rows: jnp.ndarray,
+                                  same_mec: jnp.ndarray) -> NormState:
+    """``welford_update_batch`` over the ENTITY-STRUCTURED batch without
+    materializing it: the ``A`` samples are the rows of the entity obs
+    matrix, whose position ``(j, f<F-1)`` holds ``same_mec[i, j] *
+    rows[j, f]`` and whose last feature is the is-self indicator δ_ij
+    (``envs/mec_offload._raw_obs``). Batch mean and squared-deviation sums
+    reduce to closed forms in the per-entity visible count — O(A·F) work
+    instead of O(A²·F):
+
+        cnt_j   = Σ_i same_mec[i, j]
+        bmean   = rows_j · cnt_j / A
+        bs      = cnt_j (rows_j − bmean)² + (A − cnt_j) bmean²
+        is-self: bmean = 1/A,  bs = (A−1)/A
+
+    Exact up to float reassociation vs the materialized update
+    (tests/test_normalization.py)."""
+    a = rows.shape[0]
+    cnt = same_mec.sum(axis=0).astype(jnp.float32)            # (A,)
+    frac = (cnt / a)[:, None]
+    bmean_f = rows * frac                                     # (A, F-1)
+    bs_f = (cnt[:, None] * (rows - bmean_f) ** 2
+            + (a - cnt)[:, None] * bmean_f ** 2)
+    bmean_s = jnp.full((a, 1), 1.0 / a, jnp.float32)
+    bs_s = jnp.full((a, 1), (a - 1.0) / a, jnp.float32)
+    bmean = jnp.concatenate([bmean_f, bmean_s], axis=1).reshape(-1)
+    bs = jnp.concatenate([bs_f, bs_s], axis=1).reshape(-1)
+    return _welford_merge(state, a, bmean, bs)
+
+
+def select_update(state: NormState, updated: NormState,
+                  update) -> NormState:
+    """Pick the updated statistics per the ``update`` flag, which may be a
+    Python bool or a traced scalar bool (one shared implementation for the
+    sequential, batched, and factored paths)."""
+    if isinstance(update, bool):
+        return updated if update else state
+    u = jnp.asarray(update)
+    return jax.tree.map(lambda p, q: jnp.where(u, p, q), updated, state)
+
+
+def apply_norm(state: NormState, xs: jnp.ndarray) -> jnp.ndarray:
+    """The normalization affine shared by every path (reference
+    ``Normalization.__call__`` epsilon)."""
+    return (xs - state.mean) / (state.std + 1e-8)
+
+
 def normalize_batch(state: NormState, xs: jnp.ndarray,
                     update=True) -> Tuple[NormState, jnp.ndarray]:
     """Batched counterpart of ``normalize``: one order-free merge of all
     rows, every row normalized with the post-merge statistics."""
-    if isinstance(update, bool):
-        if update:
-            state = welford_update_batch(state, xs)
-    else:
-        updated = welford_update_batch(state, xs)
-        u = jnp.asarray(update)
-        state = jax.tree.map(lambda p, q: jnp.where(u, p, q), updated, state)
-    y = (xs - state.mean) / (state.std + 1e-8)
-    return state, y
+    state = select_update(state, welford_update_batch(state, xs), update)
+    return state, apply_norm(state, xs)
 
 
 @struct.dataclass

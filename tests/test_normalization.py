@@ -132,3 +132,39 @@ def test_reward_scaling_matches_oracle():
                                    rtol=1e-4, atol=1e-5)
     rs = reset_reward_scale(rs)
     np.testing.assert_allclose(np.asarray(rs.r), 0.0)
+
+
+def test_factored_batch_update_matches_materialized():
+    """welford_update_batch_factored on (rows, mask) ≡ welford_update_batch
+    on the materialized entity matrix, for fresh and warmed states."""
+    import jax
+    import jax.numpy as jnp
+    from t2omca_tpu.envs.normalization import (
+        NormState, welford_update_batch, welford_update_batch_factored)
+
+    a, f = 5, 9
+    key = jax.random.PRNGKey(0)
+    rows = jax.random.uniform(key, (a, f - 1), minval=-2.0, maxval=2.0)
+    mec = jax.random.randint(jax.random.fold_in(key, 1), (a,), 0, 2)
+    same = mec[:, None] == mec[None, :]
+
+    raw = jnp.where(same[:, :, None],
+                    jnp.broadcast_to(rows[None], (a, a, f - 1)), 0.0)
+    raw = jnp.concatenate([raw, jnp.eye(a)[:, :, None]], axis=2)
+    raw = raw.reshape(a, a * f)
+
+    for warm in (0, 3):
+        st = NormState.create(a * f)
+        for w in range(warm):
+            st = welford_update_batch(
+                st, jax.random.normal(jax.random.fold_in(key, 10 + w),
+                                      (a, a * f)))
+        direct = welford_update_batch(st, raw)
+        factored = welford_update_batch_factored(st, rows, same)
+        np.testing.assert_allclose(factored.mean, direct.mean,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(factored.s, direct.s,
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(factored.std, direct.std,
+                                   rtol=1e-5, atol=1e-6)
+        assert int(factored.n) == int(direct.n)
