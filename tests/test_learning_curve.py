@@ -70,6 +70,21 @@ def test_qslice_run_beats_random_baseline():
         final, base)
 
 
+def test_faststack_run_beats_random_baseline():
+    """Seed-4 artifact of the FULL fast-path stack (fast_norm + entity
+    tables + compact storage + factored Welford) — the production default
+    configuration must demonstrably learn."""
+    fs_root = os.path.join(RUNS, "config1_faststack")
+    returns = _series("test_return_mean", root=fs_root,
+                      run_glob="qmix*seed4*")
+    with open(os.path.join(ROOT, "random_baseline.json")) as f:
+        base = json.load(f)
+    assert len(returns) >= 10
+    final = np.mean([v for _, v in returns[-3:]])
+    assert final > base["random_return_mean"] + 2 * base["random_return_std"], (
+        final, base)
+
+
 def test_qslice_run_loss_decreased():
     losses = _series("loss", root=QS_ROOT, run_glob="qmix*seed4*")
     first = np.mean([v for _, v in losses[:2]])
