@@ -266,6 +266,23 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
+    if not args.smoke:
+        # probe the backend FIRST: a wedged/unavailable TPU tunnel (see
+        # BASELINE.md axon note) should yield a parseable record, not a
+        # bare traceback with no JSON line
+        try:
+            jax.devices()
+        except RuntimeError as e:
+            metric, unit = (("train_steps_per_sec", "train-steps/s/chip")
+                            if args.train
+                            else ("env_steps_per_sec", "env-steps/s/chip"))
+            print(json.dumps({
+                "metric": metric, "value": None,
+                "unit": unit, "vs_baseline": None,
+                "error": f"backend unavailable: {e}"[:500],
+            }))
+            return 1
+
     from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,
                                    TrainConfig, sanity_check)
     from t2omca_tpu.run import Experiment

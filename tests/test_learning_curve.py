@@ -27,13 +27,19 @@ def _series(key, root=None, run_glob="qmix*"):
     return [(r["t"], r["value"]) for r in rows if r["key"] == key]
 
 
-def test_final_test_return_beats_random_baseline():
+@pytest.mark.parametrize("root,run_glob", [
+    (ROOT, "qmix*"),                                     # dense-path run
+    (os.path.join(RUNS, "config1_qslice"), "qmix*seed4*"),
+    (os.path.join(RUNS, "config1_faststack"), "qmix*seed4*"),
+], ids=["dense", "qslice", "faststack"])
+def test_final_test_return_beats_random_baseline(root, run_glob):
+    """One gate, three committed artifacts: the last-3-eval mean must beat
+    the measured random baseline by > 2σ of its spread."""
+    returns = _series("test_return_mean", root=root, run_glob=run_glob)
     with open(os.path.join(ROOT, "random_baseline.json")) as f:
         base = json.load(f)
-    returns = _series("test_return_mean")
     assert len(returns) >= 10
     final = np.mean([v for _, v in returns[-3:]])
-    # > 2 sigma of the random-policy spread above its mean
     assert final > base["random_return_mean"] + 2 * base["random_return_std"], (
         final, base)
 
@@ -58,31 +64,6 @@ def test_conflicts_driven_down():
 # the default fast path learns, not just that it matches the dense forward.
 
 QS_ROOT = os.path.join(RUNS, "config1_qslice")
-
-
-def test_qslice_run_beats_random_baseline():
-    returns = _series("test_return_mean", root=QS_ROOT, run_glob="qmix*seed4*")
-    with open(os.path.join(ROOT, "random_baseline.json")) as f:
-        base = json.load(f)
-    assert len(returns) >= 10
-    final = np.mean([v for _, v in returns[-3:]])
-    assert final > base["random_return_mean"] + 2 * base["random_return_std"], (
-        final, base)
-
-
-def test_faststack_run_beats_random_baseline():
-    """Seed-4 artifact of the FULL fast-path stack (fast_norm + entity
-    tables + compact storage + factored Welford) — the production default
-    configuration must demonstrably learn."""
-    fs_root = os.path.join(RUNS, "config1_faststack")
-    returns = _series("test_return_mean", root=fs_root,
-                      run_glob="qmix*seed4*")
-    with open(os.path.join(ROOT, "random_baseline.json")) as f:
-        base = json.load(f)
-    assert len(returns) >= 10
-    final = np.mean([v for _, v in returns[-3:]])
-    assert final > base["random_return_mean"] + 2 * base["random_return_std"], (
-        final, base)
 
 
 def test_qslice_run_loss_decreased():
