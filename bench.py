@@ -189,6 +189,68 @@ def _train_numbers(cfg, _time, train_bs: int | None = None) -> dict:
     }
 
 
+def bench_dp(cfg, _time, args) -> int:
+    """Config-5 measurement: the DP=8 rollout over a real device mesh
+    (BASELINE.json configs[4]). Env lanes shard over the ``data`` axis;
+    params replicate; GSPMD keeps the episode axis distributed. On a
+    machine without 8 devices use
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (CPU
+    validation) — per-chip numbers only mean something on a real slice."""
+    import dataclasses
+
+    import jax
+
+    from t2omca_tpu.parallel import DataParallel, make_mesh
+    from t2omca_tpu.run import Experiment
+
+    n_dev = 8
+    # every episode axis must divide by the mesh: round env lanes down
+    # (with a note) and the replay ring up
+    envs = (cfg.batch_size_run // n_dev) * n_dev
+    if envs != cfg.batch_size_run:
+        print(f"# rounding --envs {cfg.batch_size_run} down to {envs} "
+              f"(multiple of DP={n_dev})", file=sys.stderr)
+    if envs == 0:
+        raise SystemExit(f"--envs must be >= {n_dev} for --config 5")
+    ring = -(-max(cfg.replay.buffer_size, n_dev) // n_dev) * n_dev
+    cfg = cfg.replace(
+        batch_size_run=envs,
+        replay=dataclasses.replace(cfg.replay, buffer_size=ring))
+    exp = Experiment.build(cfg)
+    mesh = make_mesh(n_dev)
+    dp = DataParallel(exp, mesh)
+    ts = dp.shard(exp.init_train_state(0))
+    rollout, _, _ = dp.jitted_programs()
+    params = ts.learner.params["agent"]
+
+    rs, batch, _ = rollout(params, ts.runner, test_mode=False)
+    obs_leaf = jax.tree.leaves(batch.obs)[0]
+    assert len(obs_leaf.sharding.device_set) == n_dev
+
+    def one():
+        _, b, _ = rollout(params, ts.runner, test_mode=False)
+        return b.reward[0, 0]
+
+    dt = _time(one)
+    env_steps = cfg.batch_size_run * cfg.env_args.episode_limit
+    rate = env_steps / dt
+    print(f"# DP={n_dev} rollout: {dt * 1e3:.1f} ms for {env_steps} "
+          f"env-steps ({cfg.batch_size_run} envs sharded over "
+          f"{n_dev} devices)", file=sys.stderr)
+    print(json.dumps({
+        "metric": "env_steps_per_sec",
+        "value": round(rate, 1),
+        "unit": f"env-steps/s/{n_dev}-device-mesh",
+        # vs_baseline keeps the per-chip semantics of every other record
+        "vs_baseline": round(rate / n_dev / 50_000.0, 3),
+        # only claim the BASELINE scale point when unmodified
+        "config": None if args.envs or args.steps else 5,
+        "n_envs": cfg.batch_size_run, "dp": n_dev,
+        "per_chip": round(rate / n_dev, 1),
+    }))
+    return 0
+
+
 def bench_train(cfg, _time, args) -> int:
     """``--train``: the learner measurement alone, as the headline line."""
     nums = _train_numbers(cfg, _time, train_bs=4 if args.smoke else 32)
@@ -362,6 +424,16 @@ def main() -> int:
             jax.profiler.stop_trace()
             print(f"# trace written to {args.profile}", file=sys.stderr,
                   flush=True)
+
+    if args.config == 5 and not args.smoke:
+        # the DP=8 scale point has its own program shape (sharded mesh);
+        # --train/--breakdown stay single-chip modes
+        if args.train or args.breakdown:
+            raise SystemExit(
+                "--config 5 measures the DP rollout; use configs 1-4 for "
+                "--train/--breakdown")
+        with tracing():
+            return bench_dp(cfg, _time, args)
 
     if args.train or args.breakdown:
         # whole-mode trace (includes compiles; the default mode traces only
