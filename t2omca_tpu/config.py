@@ -120,6 +120,11 @@ class TrainConfig:
     batch_size: int = 32                  # train batch (episodes)
     accumulated_episodes: int = 0         # min episodes collected before training
     use_cuda: bool = False                # parity flag; device selection is JAX's
+    # data parallelism (SURVEY.md §7.2(6)): shard env lanes + replay
+    # episodes over a `dp_devices`-wide mesh data axis (parallel/mesh.py);
+    # 0 = single-device programs. Replaces the reference's single-device
+    # select (/root/reference/per_run.py:26).
+    dp_devices: int = 0
     evaluate: bool = False
     benchmark_mode: bool = False          # export per-episode CSV during eval
     checkpoint_path: str = ""
@@ -219,6 +224,14 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
             "dropout is only implemented by the transformer families; "
             f"agent='{cfg.agent}' + mixer='{cfg.mixer}' configures no "
             "module that would apply it")
+    if cfg.dp_devices:
+        if cfg.dp_devices < 0:
+            raise ValueError(f"dp_devices must be >= 0, got {cfg.dp_devices}")
+        if cfg.replay.buffer_cpu_only:
+            raise ValueError(
+                "dp_devices shards the device-resident replay ring; "
+                "buffer_cpu_only keeps storage in host RAM — pick one")
+        check_dp_divisibility(cfg, cfg.dp_devices)
     if cfg.mixer == "transformer" and cfg.model.mixer_emb != cfg.model.emb:
         raise ValueError(
             "mixer_emb must equal emb: the transformer mixer concatenates "
@@ -226,6 +239,20 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
             "mixer_emb) (reference n_transf_mixer.py:69)."
         )
     return cfg.replace(test_nepisode=tn)
+
+
+def check_dp_divisibility(cfg: TrainConfig, n: int,
+                          axis_label: str = "dp_devices") -> None:
+    """The data-parallel shape invariant, shared by ``sanity_check`` (early,
+    at config load) and ``parallel.DataParallel`` (late, at mesh build):
+    every episode-axis quantity must split evenly over the mesh."""
+    if (cfg.batch_size_run % n or cfg.batch_size % n
+            or cfg.replay.buffer_size % n):
+        raise ValueError(
+            f"batch_size_run={cfg.batch_size_run}, "
+            f"batch_size={cfg.batch_size} and "
+            f"replay.buffer_size={cfg.replay.buffer_size} must all be "
+            f"divisible by {axis_label}={n}")
 
 
 def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:

@@ -79,15 +79,9 @@ class DataParallel:
     axis: str = "data"
 
     def __post_init__(self):
-        n = self.mesh.shape[self.axis]
-        cfg = self.exp.cfg
-        if (cfg.batch_size_run % n or cfg.batch_size % n
-                or cfg.replay.buffer_size % n):
-            raise ValueError(
-                f"batch_size_run={cfg.batch_size_run}, "
-                f"batch_size={cfg.batch_size} and replay "
-                f"buffer_size={cfg.replay.buffer_size} must all be divisible "
-                f"by the '{self.axis}' axis size {n}")
+        from ..config import check_dp_divisibility
+        check_dp_divisibility(self.exp.cfg, self.mesh.shape[self.axis],
+                              axis_label=f"the '{self.axis}' axis size")
 
     # ------------------------------------------------------------------ state
 
@@ -117,13 +111,18 @@ class DataParallel:
 
     # ------------------------------------------------------------------ programs
 
-    def jitted_programs(self):
+    def jitted_programs(self, donate: bool = False):
         """The experiment's own three programs with a
         ``with_sharding_constraint`` injected on every episode batch, so the
         episode axis stays distributed end-to-end (rollout → insert →
         sample → train; grads are psum'd by GSPMD since params are
-        replicated and the loss averages over a sharded batch)."""
+        replicated and the loss averages over a sharded batch).
+
+        ``donate`` has the same contract as
+        ``Experiment.jitted_programs(donate=...)``: in-place replay ring and
+        train state for drivers that never reuse the pre-call value."""
         batch_sharding = NamedSharding(self.mesh, P(self.axis))
         return self.exp.jitted_programs(
             constrain_batch=lambda b: jax.lax.with_sharding_constraint(
-                b, batch_sharding))
+                b, batch_sharding),
+            donate=donate)

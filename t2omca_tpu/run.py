@@ -219,9 +219,19 @@ def run_sequential(exp: Experiment, logger: Logger,
     log.info(f"env_info: {env_info}")
 
     ts = exp.init_train_state(cfg.seed)
+    # ---- data parallelism (SURVEY.md §7.2(6)) --------------------------
+    # dp_devices > 0 swaps in the mesh-sharded program triple; the loop
+    # below is identical either way (same pure functions, GSPMD shardings
+    # come from input placement — parallel/mesh.py)
+    dp = None
+    if cfg.dp_devices:
+        from .parallel import DataParallel, make_mesh
+        dp = DataParallel(exp, make_mesh(cfg.dp_devices))
+        log.info(f"data-parallel over {cfg.dp_devices} devices "
+                 f"(mesh axis 'data')")
     # the driver loop replaces its state right after every call, so the
     # replay ring / train state can be donated (in-place on device)
-    rollout, insert, train_iter = exp.jitted_programs(donate=True)
+    rollout, insert, train_iter = (dp or exp).jitted_programs(donate=True)
     key = jax.random.PRNGKey(cfg.seed + 1)
 
     t_env = 0
@@ -237,6 +247,10 @@ def run_sequential(exp: Experiment, logger: Logger,
             ts = ts.replace(runner=ts.runner.replace(
                 t_env=jnp.asarray(step, jnp.int32)))
             log.info(f"resumed from {dirname} at t_env={step}")
+    if dp is not None:
+        # place/re-place the (possibly restored) state on the mesh: params
+        # replicated, env lanes + replay episodes sharded on the data axis
+        ts = dp.shard(ts)
 
     model_dir = os.path.join(cfg.local_results_path, "models",
                              os.path.basename(results_dir))
