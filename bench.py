@@ -190,37 +190,49 @@ def _train_numbers(cfg, _time, train_bs: int | None = None) -> dict:
 
 
 def bench_dp(cfg, _time, args) -> int:
-    """Config-5 measurement: the DP=8 rollout over a real device mesh
-    (BASELINE.json configs[4]). Env lanes shard over the ``data`` axis;
-    params replicate; GSPMD keeps the episode axis distributed. On a
-    machine without 8 devices use
+    """Config-5 measurement: the DP=8 training loop over a real device mesh
+    (BASELINE.json configs[4]). Env lanes and replay episodes shard over the
+    ``data`` axis; params replicate; GSPMD keeps the episode axis
+    distributed and psums the grads. Measures BOTH metric halves: the
+    rollout (env-steps/s) and the train iteration (PER sample → QMIX train
+    over the episode scan → priority feedback; reference hot loop
+    /root/reference/per_run.py:224-238). ``--train`` makes the train half
+    the headline record. On a machine without 8 devices use
     ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (CPU
     validation) — per-chip numbers only mean something on a real slice."""
     import dataclasses
 
     import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from t2omca_tpu.parallel import DataParallel, make_mesh
     from t2omca_tpu.run import Experiment
 
     n_dev = 8
     # every episode axis must divide by the mesh: round env lanes down
-    # (with a note) and the replay ring up
+    # (with a note) and the replay ring up. The ring holds one train
+    # batch's worth of episodes (2×batch_size): train cost scales with the
+    # sampled batch, not ring capacity (PER sampling is O(capacity)
+    # vectorized — negligible), so the bench doesn't pay config-5's
+    # production-sized ring HBM just to time the iteration.
     envs = (cfg.batch_size_run // n_dev) * n_dev
     if envs != cfg.batch_size_run:
         print(f"# rounding --envs {cfg.batch_size_run} down to {envs} "
               f"(multiple of DP={n_dev})", file=sys.stderr)
     if envs == 0:
         raise SystemExit(f"--envs must be >= {n_dev} for --config 5")
-    ring = -(-max(cfg.replay.buffer_size, n_dev) // n_dev) * n_dev
+    bs = min(32, envs)
+    ring = -(-max(cfg.replay.buffer_size, 2 * bs) // n_dev) * n_dev
     cfg = cfg.replace(
-        batch_size_run=envs,
-        replay=dataclasses.replace(cfg.replay, buffer_size=ring))
+        batch_size_run=envs, batch_size=bs,
+        replay=dataclasses.replace(cfg.replay, buffer_size=ring,
+                                   prioritized=True))
     exp = Experiment.build(cfg)
     mesh = make_mesh(n_dev)
     dp = DataParallel(exp, mesh)
     ts = dp.shard(exp.init_train_state(0))
-    rollout, _, _ = dp.jitted_programs()
+    rollout, insert, train_iter = dp.jitted_programs()
     params = ts.learner.params["agent"]
 
     rs, batch, _ = rollout(params, ts.runner, test_mode=False)
@@ -237,17 +249,57 @@ def bench_dp(cfg, _time, args) -> int:
     print(f"# DP={n_dev} rollout: {dt * 1e3:.1f} ms for {env_steps} "
           f"env-steps ({cfg.batch_size_run} envs sharded over "
           f"{n_dev} devices)", file=sys.stderr)
-    print(json.dumps({
+
+    # ---- train half: fill the ring with a slice of real episodes (the
+    # rollout batch can exceed ring capacity at config-5 scale), keeping
+    # the episode axis sharded, then time the full DP train iteration
+    fill = jax.tree.map(lambda x: x[:ring], batch)
+    fill = jax.device_put(fill, NamedSharding(mesh, P("data")))
+    ts = ts.replace(runner=rs, buffer=insert(ts.buffer, fill),
+                    episode=jnp.asarray(ring, jnp.int32))
+    key = jax.random.PRNGKey(7)
+
+    def one_train():
+        _, info = train_iter(ts, key, jnp.asarray(1000))
+        return info["loss"]
+
+    dt_train = _time(one_train)
+    ts2, _ = train_iter(ts, key, jnp.asarray(1000))
+    leaf = jax.tree.leaves(ts2.learner.params)[0]
+    assert leaf.sharding.is_fully_replicated, \
+        "params must stay replicated through the DP train step"
+    t_len = cfg.env_args.episode_limit
+    print(f"# DP={n_dev} train_iter ({bs} episodes x {t_len + 1} slots, "
+          f"PER on): {dt_train * 1e3:.1f} ms -> "
+          f"{1.0 / dt_train:.2f} train-steps/s", file=sys.stderr)
+
+    cfg_id = None if args.envs or args.steps else 5
+    rollout_rec = {
         "metric": "env_steps_per_sec",
         "value": round(rate, 1),
         "unit": f"env-steps/s/{n_dev}-device-mesh",
         # vs_baseline keeps the per-chip semantics of every other record
         "vs_baseline": round(rate / n_dev / 50_000.0, 3),
         # only claim the BASELINE scale point when unmodified
-        "config": None if args.envs or args.steps else 5,
+        "config": cfg_id,
         "n_envs": cfg.batch_size_run, "dp": n_dev,
         "per_chip": round(rate / n_dev, 1),
-    }))
+        "train_steps_per_sec": round(1.0 / dt_train, 2),
+        "train_batch_episodes": bs,
+    }
+    if args.train:
+        print(json.dumps({
+            "metric": "train_steps_per_sec",
+            "value": round(1.0 / dt_train, 2),
+            "unit": f"train-steps/s/{n_dev}-device-mesh",
+            "vs_baseline": None,
+            "config": cfg_id,
+            "dp": n_dev,
+            "train_batch_episodes": bs,
+            "env_steps_per_sec": round(rate, 1),
+        }))
+    else:
+        print(json.dumps(rollout_rec))
     return 0
 
 
@@ -427,11 +479,12 @@ def main() -> int:
 
     if args.config == 5 and not args.smoke:
         # the DP=8 scale point has its own program shape (sharded mesh);
-        # --train/--breakdown stay single-chip modes
-        if args.train or args.breakdown:
+        # bench_dp measures both metric halves (--train flips the headline);
+        # --breakdown stays a single-chip mode
+        if args.breakdown:
             raise SystemExit(
-                "--config 5 measures the DP rollout; use configs 1-4 for "
-                "--train/--breakdown")
+                "--config 5 measures the DP loop; use configs 1-4 for "
+                "--breakdown")
         with tracing():
             return bench_dp(cfg, _time, args)
 
