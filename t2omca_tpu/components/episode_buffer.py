@@ -59,8 +59,9 @@ class EpisodeBatch:
     obs: jnp.ndarray            # (B, T+1, A, obs_dim) float32 — or a
                                 # CompactEntityObs pytree (compact storage)
     state: jnp.ndarray          # (B, T+1, state_dim) float32
-    avail_actions: jnp.ndarray  # (B, T+1, A, n_actions) int8 (storage; all
-                                # consumers only compare > 0)
+    avail_actions: jnp.ndarray  # (B, T+1, A, n_actions) bool (storage; a
+                                # predicate — arithmetic misuse is a type
+                                # error by construction)
     actions: jnp.ndarray        # (B, T, A) int32
     reward: jnp.ndarray         # (B, T) float32
     terminated: jnp.ndarray     # (B, T) bool — env-terminal, time-limit excluded (Q7)
@@ -112,7 +113,7 @@ def _zeros_like_episode(n_agents: int, n_actions: int, obs_dim: int,
     return EpisodeBatch(
         obs=obs,
         state=jnp.zeros((batch, t + 1, state_dim), store_dtype),
-        avail_actions=jnp.zeros((batch, t + 1, n_agents, n_actions), jnp.int8),
+        avail_actions=jnp.zeros((batch, t + 1, n_agents, n_actions), bool),
         actions=jnp.zeros((batch, t, n_agents), jnp.int32),
         reward=jnp.zeros((batch, t), jnp.float32),
         terminated=jnp.zeros((batch, t), bool),

@@ -133,7 +133,7 @@ class HostReplayBuffer:
             obs=np.zeros((cap, t + 1, self.n_agents, self.obs_dim), sd),
             state=np.zeros((cap, t + 1, self.state_dim), sd),
             avail_actions=np.zeros((cap, t + 1, self.n_agents,
-                                    self.n_actions), np.int8),
+                                    self.n_actions), bool),
             actions=np.zeros((cap, t, self.n_agents), np.int32),
             reward=np.zeros((cap, t), np.float32),
             terminated=np.zeros((cap, t), bool),

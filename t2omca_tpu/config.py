@@ -35,10 +35,15 @@ class EnvConfig:
     state_entity_mode: bool = True
     state_last_action: bool = False
     edge_only: bool = False
-    # perf mode: one order-free batched Welford update per step instead of
-    # the reference's sequential per-agent loop (O(A/n) transient deviation;
-    # see envs/normalization.py:welford_update_batch)
-    fast_norm: bool = False
+    # one order-free batched Welford update per step instead of the
+    # reference's sequential per-agent loop (O(A/n) transient deviation;
+    # see envs/normalization.py:welford_update_batch). Default ON: it gates
+    # the whole fast-path stack (entity-table acting + compact entity
+    # storage, ops/query_slice.py eligibility predicates) and is validated
+    # end-to-end by the config-1 faststack sweep
+    # (runs/config1_faststack/SUMMARY.md). Reference-exact parity configs
+    # (sequential normalizer ordering) opt out with fast_norm=False.
+    fast_norm: bool = True
 
     # ----- physics / M1 spec values (frozen in docs/SPEC.md §1; the reference
     # does not release data_struct_multiagv, so these are our pinned choices)

@@ -103,7 +103,12 @@ def entity_store_eligible(cfg) -> bool:
     return (cfg.replay.compact_entity_store
             and entity_tables_eligible(cfg)
             and cfg.env_args.state_entity_mode
-            and not cfg.replay.buffer_cpu_only)
+            and not cfg.replay.buffer_cpu_only
+            # the stored mec_index narrows to int8
+            # (runners/parallel_runner.py obs_store); ids are 0..mec_num-1,
+            # so any id past 127 would alias and corrupt reconstructed
+            # same-MEC visibility
+            and cfg.env_args.mec_num <= 128)
 
 
 def mixer_qslice_eligible(cfg) -> bool:

@@ -29,7 +29,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from ..models.mixer import TransformerMixer
 from .ring_attention import ring_attention
@@ -125,7 +125,7 @@ def mixer_apply_sp(mixer: TransformerMixer, variables, qvals: jnp.ndarray,
         inner, mesh=mesh,
         in_specs=(P(), P(None, axis, None), P(axis)),
         out_specs=P(None, axis, None),
-        check_rep=False,
+        check_vma=False,
     )(p["transformer"], tokens, valid)
     out = out[:, :t, :].astype(jnp.float32)
 

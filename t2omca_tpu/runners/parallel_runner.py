@@ -169,9 +169,10 @@ class ParallelRunner:
             # Q15: the action is recorded with the pre-step observation.
             # Cast to the storage dtype here so the scan stacks the compact
             # representation (the f32 episode stack is the HBM hot spot);
-            # avail narrows to int8 — every consumer only compares > 0
+            # avail narrows to bool — it is a predicate, and bool storage
+            # makes arithmetic misuse a type error
             pre = (obs_store(env_states, obs, compact), gstate.astype(sd),
-                   avail.astype(jnp.int8), actions)
+                   avail > 0, actions)
             viz = ((env_states.pos, env_states.mec_index)
                    if capture else None)
             env_states, reward, terminated, info, obs, gstate, avail = \
@@ -204,7 +205,7 @@ class ParallelRunner:
         batch = EpisodeBatch(
             obs=cat_last(obs_seq, last_obs_store),
             state=cat_last(gstate_seq, last_gstate.astype(sd)),
-            avail_actions=cat_last(avail_seq, last_avail.astype(jnp.int8)),
+            avail_actions=cat_last(avail_seq, last_avail > 0),
             actions=bt(action_seq),
             reward=bt(reward),
             terminated=bt(env_terminal),

@@ -16,19 +16,40 @@ Directory layout and nearest-``load_step`` selection mirror the reference.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any, Optional, Tuple
 
 import jax
 from flax import serialization
 
+#: bump when the checkpointed pytree layout changes incompatibly
+#: (v2: bool avail storage + meta sidecar)
+FORMAT_VERSION = 2
+
+
+def _obs_layout(state: Any) -> Optional[str]:
+    """'compact' | 'dense' | None (host buffer keeps state outside the tree)."""
+    from ..components.episode_buffer import CompactEntityObs
+    buf = getattr(state, "buffer", None)
+    if buf is None:
+        return None
+    return ("compact" if isinstance(buf.storage.obs, CompactEntityObs)
+            else "dense")
+
 
 def save_checkpoint(path: str, t_env: int, state: Any) -> str:
-    """Write ``<path>/<t_env>/state.msgpack``."""
+    """Write ``<path>/<t_env>/state.msgpack`` + a ``meta.json`` sidecar
+    recording the format version and replay obs layout, so a restore with
+    a mismatched ``replay.compact_entity_store`` fails with the exact flag
+    to toggle instead of a deep msgpack structure error."""
     d = os.path.join(path, str(int(t_env)))
     os.makedirs(d, exist_ok=True)
     with open(os.path.join(d, "state.msgpack"), "wb") as f:
         f.write(serialization.to_bytes(jax.device_get(state)))
+    with open(os.path.join(d, "meta.json"), "w") as f:
+        json.dump({"format": FORMAT_VERSION, "obs_layout": _obs_layout(state),
+                   "t_env": int(t_env)}, f)
     return d
 
 
@@ -50,7 +71,29 @@ def find_checkpoint(path: str, load_step: int = 0) -> Optional[Tuple[str, int]]:
 
 
 def load_checkpoint(dirname: str, target: Any) -> Any:
-    """Restore into a template pytree of the same structure."""
+    """Restore into a template pytree of the same structure. The
+    ``meta.json`` sidecar (when present) turns a replay-layout mismatch
+    into a precise config instruction before any deserialization."""
+    meta_path = os.path.join(dirname, "meta.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        fmt = meta.get("format", 0)
+        if fmt > FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint {dirname} has format v{fmt}, newer than this "
+                f"build's v{FORMAT_VERSION} — upgrade the framework to "
+                f"restore it")
+        saved = meta.get("obs_layout")
+        configured = _obs_layout(target)
+        if saved and configured and saved != configured:
+            want = "true" if saved == "compact" else "false"
+            raise ValueError(
+                f"checkpoint {dirname} stores the replay ring with "
+                f"'{saved}' obs layout but the config builds '{configured}' "
+                f"storage — set replay.compact_entity_store={want} (and for "
+                f"'compact' keep env_args.fast_norm=true) to resume this "
+                f"checkpoint (docs/SPEC.md perf modes)")
     with open(os.path.join(dirname, "state.msgpack"), "rb") as f:
         data = f.read()
     try:

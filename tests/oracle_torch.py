@@ -100,3 +100,75 @@ def mixer_forward(p, qvals, hidden_states, hyper_weights, states, obs, *,
     hid = F.elu(qvals @ w1 + b1)
     y = hid @ w2 + b2
     return y, out[:, -3:, :]
+
+
+# --------------------------------------------------------------------- QMIX
+
+def qmix_episode_loss(p_ag, p_mx, tp_ag, tp_mx, batch, weights, *, gamma,
+                      n_agents, agent_kw, mixer_kw, double_q=True):
+    """The full QMIX loss on one episode batch — the oracle for
+    ``learners/qmix_learner.py:_loss`` (M8 contract, SURVEY.md §3.3):
+    double-Q targets with avail masking, BOTH recurrent streams carried
+    from t=0 (agent hidden token + mixer hyper tokens; the target mixer
+    unrolls over all T+1 steps and its outputs [1:] are the bootstraps),
+    time-limit steps bootstrap (Q7: ``terminated`` excludes them), and the
+    importance-weighted masked MSE.
+
+    ``batch``: dict of torch tensors — obs ``(B, T+1, A, O)``,
+    state ``(B, T+1, S)``, avail ``(B, T+1, A, n)``, actions ``(B, T, A)``
+    long, reward/terminated/filled ``(B, T)``. ``agent_kw``/``mixer_kw``
+    forward to :func:`agent_forward` / :func:`mixer_forward`.
+    """
+    obs, state = batch["obs"], batch["state"]
+    avail, actions = batch["avail"], batch["actions"]
+    reward, term, mask = (batch["reward"], batch["terminated"],
+                          batch["filled"])
+    b, t1 = obs.shape[0], obs.shape[1]
+    t = t1 - 1
+    emb = agent_kw["emb"]
+
+    def unroll_agent(p):
+        hidden = torch.zeros(b, n_agents, emb)
+        qs, hs = [], []
+        for i in range(t1):
+            q, hidden = agent_forward(p, obs[:, i], hidden, **agent_kw)
+            qs.append(q)
+            hs.append(hidden)
+        return torch.stack(qs, 1), torch.stack(hs, 1)   # (B, T+1, A, ...)
+
+    qs, hs = unroll_agent(p_ag)
+    with torch.no_grad():
+        target_qs, target_hs = unroll_agent(tp_ag)
+
+    chosen = qs[:, :t].gather(-1, actions.unsqueeze(-1)).squeeze(-1)
+
+    masked_all = qs.masked_fill(avail <= 0, -torch.inf)
+    if double_q:
+        best = masked_all.argmax(dim=-1, keepdim=True)
+        target_max = target_qs.gather(-1, best).squeeze(-1)  # (B, T+1, A)
+    else:
+        target_max = target_qs.masked_fill(avail <= 0,
+                                           -torch.inf).max(dim=-1).values
+
+    memb = mixer_kw["emb"]
+
+    def unroll_mixer(p, qv_seq, h_seq, steps, grad=True):
+        hyper = torch.zeros(b, 3, memb)
+        outs = []
+        ctx = torch.enable_grad() if grad else torch.no_grad()
+        with ctx:
+            for i in steps:
+                y, hyper = mixer_forward(
+                    p, qv_seq[:, i].unsqueeze(1), h_seq[:, i], hyper,
+                    state[:, i], obs[:, i], **mixer_kw)
+                outs.append(y[:, 0, 0])
+        return torch.stack(outs, 1)                      # (B, len(steps))
+
+    q_tot = unroll_mixer(p_mx, chosen, hs, range(t))
+    target_q_tot = unroll_mixer(tp_mx, target_max, target_hs, range(t1),
+                                grad=False)[:, 1:]
+
+    targets = reward + gamma * (1.0 - term) * target_q_tot
+    td = (q_tot - targets.detach()) * mask
+    denom = torch.clamp(mask.sum(), min=1.0)
+    return (weights[:, None] * td ** 2).sum() / denom

@@ -16,8 +16,10 @@ from t2omca_tpu.run import Experiment
 def bf16_exp():
     cfg = sanity_check(TrainConfig(
         batch_size_run=2, batch_size=2,
+        # fast_norm=False: this fixture pins the DENSE bf16 storage path
+        # (compact entity storage keeps its leaves f32 by design)
         env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
-                           episode_limit=4),
+                           episode_limit=4, fast_norm=False),
         model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
                           mixer_heads=2, mixer_depth=1,
                           standard_heads=True, dtype="bfloat16"),

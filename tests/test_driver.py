@@ -177,3 +177,24 @@ def test_evaluate_path_exports_replay_and_benchmark(tmp_path):
     assert csvs, "benchmark CSV missing"
     data = np.load(replays[0])
     assert "pos" in data and data["pos"].shape[0] == cfg.env_args.episode_limit
+
+
+def test_checkpoint_layout_mismatch_names_the_flag(tmp_path):
+    """A compact-storage checkpoint restored into a dense-storage config
+    must fail with the exact flag to toggle (meta.json sidecar), not a
+    deep msgpack structure error."""
+    import dataclasses
+
+    from t2omca_tpu.utils.checkpoint import save_checkpoint
+
+    cfg = tiny_cfg(tmp_path)          # defaults: compact entity storage
+    exp = Experiment.build(cfg)
+    d = save_checkpoint(str(tmp_path / "ckpt"), 100, exp.init_train_state(0))
+    assert os.path.exists(os.path.join(d, "meta.json"))
+
+    cfg_dense = tiny_cfg(tmp_path, env_args=EnvConfig(
+        agv_num=3, mec_num=2, num_channels=2, episode_limit=6,
+        fast_norm=False))
+    exp_dense = Experiment.build(cfg_dense)
+    with pytest.raises(ValueError, match="compact_entity_store=true"):
+        load_checkpoint(d, exp_dense.init_train_state(0))

@@ -222,3 +222,40 @@ def test_compact_obs_reconstructs_full_obs():
     norm = (raw - mean[:, None]) / denom[:, None]
     np.testing.assert_allclose(norm.reshape(b, a, a * f), obs,
                                rtol=1e-5, atol=1e-6)
+
+
+def test_default_config_resolves_to_full_fast_stack():
+    """TrainConfig() defaults land on the documented production path
+    (BASELINE.md / docs/ROUND3.md "default on"): entity-table acting +
+    compact entity storage, with fast_norm gating satisfied (VERDICT r3
+    Weak #3 — config, docs, and this pin must agree)."""
+    from t2omca_tpu.config import TrainConfig, sanity_check
+    from t2omca_tpu.ops.query_slice import (agent_qslice_eligible,
+                                            entity_store_eligible,
+                                            entity_tables_eligible)
+    cfg = sanity_check(TrainConfig())
+    assert cfg.env_args.fast_norm
+    assert agent_qslice_eligible(cfg)
+    assert entity_tables_eligible(cfg)
+    assert entity_store_eligible(cfg)
+    # and the built experiment actually wires those paths
+    exp = Experiment.build(cfg.replace(
+        env_args=dataclasses.replace(cfg.env_args, episode_limit=4),
+        replay=dataclasses.replace(cfg.replay, buffer_size=8)))
+    assert exp.mac.use_entity_tables
+    assert exp.buffer.compact_obs
+
+
+def test_compact_store_ineligible_past_int8_mec_range():
+    """mec_index narrows to int8 in compact storage; ids are 0..mec_num-1,
+    so mec_num=128 (max id 127) still fits and mec_num=129 would alias —
+    the eligibility predicate must fall back to dense storage there."""
+    from t2omca_tpu.ops.query_slice import entity_store_eligible
+    base = sanity_check(TrainConfig())
+    assert entity_store_eligible(base)
+    at_edge = base.replace(env_args=dataclasses.replace(
+        base.env_args, mec_num=128, agv_num=256))
+    assert entity_store_eligible(at_edge)
+    big = base.replace(env_args=dataclasses.replace(
+        base.env_args, mec_num=129, agv_num=256))
+    assert not entity_store_eligible(big)

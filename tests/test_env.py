@@ -324,7 +324,7 @@ def test_fast_norm_env_equivalence():
     """fast_norm changes only get_obs: running statistics stay in lockstep
     with the sequential reference path along a shared trajectory, and the
     normalized observations converge (O(A/n) transient)."""
-    env_seq = make_env()
+    env_seq = make_env(fast_norm=False)   # sequential reference path
     env_fast = make_env(fast_norm=True)
     st, obs_seq, *_ = env_seq.reset(KEY)
     fast_norm = env_fast.get_obs(st.replace(norm=NormState.create(

@@ -19,8 +19,11 @@ from t2omca_tpu.runners import ParallelRunner
 def setup():
     cfg = sanity_check(TrainConfig(
         batch_size_run=2, batch_size=3, target_update_interval=4,
+        # fast_norm=False: this module pins the DENSE rollout/learner
+        # contract (flat obs tensors); the compact-storage equivalents
+        # live in tests/test_entity_tables.py
         env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
-                           episode_limit=6),
+                           episode_limit=6, fast_norm=False),
         model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
                           mixer_heads=2, mixer_depth=1),
         replay=ReplayConfig(buffer_size=10),

@@ -1,0 +1,164 @@
+"""End-to-end learning parity: the jax QMIX learner's loss trajectory vs
+the PyTorch oracle (BASELINE.json north-star quality target — "loss curve
+matches the PyTorch CPU reference path"; SURVEY.md §7.4(2)).
+
+Both sides receive the IDENTICAL sequence of real rollout batches and IS
+weights and run the IDENTICAL optimizer (Adam lr=1e-3 eps=1e-5 under
+global-norm-10 clipping) for 20 train steps in LOCKSTEP: each step the
+torch oracle is re-synced to the jax params, both compute the loss and
+apply their own update, and the per-step losses AND post-update parameters
+must agree tightly. Lockstep is deliberate — free-running trajectories
+diverge chaotically through the double-Q argmax (a ~1e-6 f32 forward
+difference flips a target action choice and macroscopically changes the
+loss a few steps later), which would force uselessly loose tolerances;
+re-syncing pins every step's full learner math — the double-Q target
+construction, both recurrent unrolls from t=0, Q7 bootstrapping, the
+IS-weighted masked MSE, and (via the post-update parameter check, with
+torch's Adam moments persisting across steps) the optimizer wiring — at
+f32-forward precision for all 20 steps.
+
+Scale: config 1's model/env point (4 AGVs x 2 MEC, d_model=64, reference
+parity mode fast_norm=False => dense storage + sequential normalizer),
+with the episode horizon shortened 150->12 to keep the torch python-loop
+oracle tractable (the math is horizon-independent)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,
+                               TrainConfig, sanity_check)
+from t2omca_tpu.run import Experiment
+
+import oracle_torch as oracle
+from test_models_parity import to_torch_params
+
+N_STEPS = 20
+
+
+def _cfg():
+    return sanity_check(TrainConfig(
+        batch_size_run=4, batch_size=4, lr=1e-3, optim_eps=1e-5,
+        grad_norm_clip=10.0, gamma=0.99, double_q=True,
+        env_args=EnvConfig(agv_num=4, mec_num=2, num_channels=2,
+                           episode_limit=12, fast_norm=False),
+        model=ModelConfig(emb=64, heads=3, depth=2, mixer_emb=64,
+                          mixer_heads=3, mixer_depth=2),
+        replay=ReplayConfig(buffer_size=8, prioritized=False),
+    ))
+
+
+def _collect_batches(exp, ts, n):
+    """n rollout batches under the FIXED initial params (data collection is
+    decoupled so both learners see the identical sequence)."""
+    rollout = jax.jit(exp.runner.run, static_argnames="test_mode")
+    params = ts.learner.params["agent"]
+    rs = ts.runner
+    batches = []
+    for _ in range(n):
+        rs, batch, _ = rollout(params, rs, test_mode=False)
+        batches.append(jax.device_get(batch))
+    return batches
+
+
+def _to_torch(batch):
+    return {
+        "obs": torch.tensor(np.asarray(batch.obs, np.float32)),
+        "state": torch.tensor(np.asarray(batch.state, np.float32)),
+        "avail": torch.tensor(np.asarray(batch.avail_actions, np.int64)),
+        "actions": torch.tensor(np.asarray(batch.actions, np.int64)),
+        "reward": torch.tensor(np.asarray(batch.reward, np.float32)),
+        "terminated": torch.tensor(
+            np.asarray(batch.terminated, np.float32)),
+        "filled": torch.tensor(np.asarray(batch.filled, np.float32)),
+    }
+
+
+def test_qmix_loss_trajectory_matches_torch_oracle():
+    cfg = _cfg()
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(0)
+    batches = _collect_batches(exp, ts, N_STEPS)
+    # fixed non-uniform IS weights, max-normalized like the PER path
+    w = jax.random.uniform(jax.random.PRNGKey(42),
+                           (N_STEPS, cfg.batch_size), minval=0.3)
+    w = np.asarray(w / w.max(axis=1, keepdims=True), np.float32)
+
+    # (episode pinned at 0: no target sync inside the 20-step horizon)
+    train = jax.jit(exp.learner.train)
+
+    # ---- torch oracle from the same initial weights
+    ag = exp.mac.agent
+    mx = exp.learner.mixer
+    agent_kw = dict(n_entities=ag.n_entities, feat_dim=ag.feat_dim,
+                    emb=ag.emb, heads=ag.heads, depth=ag.depth)
+    mixer_kw = dict(n_agents=mx.n_agents, n_entities=mx.n_entities,
+                    feat_dim=mx.feat_dim, emb=mx.emb, heads=mx.heads,
+                    depth=mx.depth, state_entity_mode=mx.state_entity_mode,
+                    pos=mx.qmix_pos_func, pos_beta=mx.qmix_pos_func_beta)
+
+    p0 = jax.device_get(ts.learner.params)
+    p_ag = {k: v.clone().requires_grad_(True)
+            for k, v in to_torch_params(p0["agent"]["params"]).items()}
+    p_mx = {k: v.clone().requires_grad_(True)
+            for k, v in to_torch_params(p0["mixer"]["params"]).items()}
+    tp_ag = {k: v.detach().clone() for k, v in p_ag.items()}
+    tp_mx = {k: v.detach().clone() for k, v in p_mx.items()}
+    leaves = list(p_ag.values()) + list(p_mx.values())
+    opt = torch.optim.Adam(leaves, lr=cfg.lr, eps=cfg.optim_eps)
+
+    # ---- lockstep: both sides step together from the same params
+    ls = ts.learner
+    losses_j, losses_t = [], []
+    for i, batch in enumerate(batches):
+        cur = jax.device_get(ls.params)
+        with torch.no_grad():
+            for k, v in to_torch_params(cur["agent"]["params"]).items():
+                p_ag[k].copy_(v)
+            for k, v in to_torch_params(cur["mixer"]["params"]).items():
+                p_mx[k].copy_(v)
+        loss = oracle.qmix_episode_loss(
+            p_ag, p_mx, tp_ag, tp_mx, _to_torch(batch),
+            torch.tensor(w[i]), gamma=cfg.gamma,
+            n_agents=exp.mac.n_agents, agent_kw=agent_kw,
+            mixer_kw=mixer_kw, double_q=cfg.double_q)
+        opt.zero_grad()
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(leaves, cfg.grad_norm_clip)
+        opt.step()
+        losses_t.append(float(loss.detach()))
+
+        # jax takes the same step from the same params
+        ls, info = train(ls, jax.tree.map(jnp.asarray, batch),
+                         jnp.asarray(w[i]), jnp.asarray(0),
+                         jnp.asarray(0, jnp.int32))
+        losses_j.append(float(info["loss"]))
+        # post-update parameter parity pins the grad + clip + Adam wiring
+        # (torch's moments persist across steps, fed by matched grads).
+        # Isolated elements may legitimately deviate — an f32 near-tie in
+        # the double-Q argmax can resolve differently across frameworks,
+        # changing a handful of gradient entries — so the gate bounds the
+        # OUTLIER FRACTION (≤0.1%) and the worst excursion (a few lr-scale
+        # updates) instead of demanding all-element closeness; a real
+        # wiring bug moves most elements at lr scale every step.
+        new = jax.device_get(ls.params)
+        for flat, tree in ((p_ag, new["agent"]["params"]),
+                           (p_mx, new["mixer"]["params"])):
+            for k, v in to_torch_params(tree).items():
+                a = flat[k].detach().numpy()
+                b = v.numpy()
+                diff = np.abs(a - b)
+                bad = diff > (5e-5 + 2e-3 * np.abs(b))
+                assert bad.mean() <= 1e-3, (
+                    f"step {i}: {bad.sum()}/{bad.size} elements of {k} "
+                    f"diverged (max |d|={diff.max():.2e})")
+                assert diff.max() <= 5e-3, (
+                    f"step {i}: {k} max |d|={diff.max():.2e} exceeds a "
+                    f"few lr-scale updates")
+
+    losses_j, losses_t = np.asarray(losses_j), np.asarray(losses_t)
+    # every step's loss at f32-forward precision (lockstep: no chaos)
+    np.testing.assert_allclose(losses_j, losses_t, rtol=5e-4)
+    # and the jax trajectory actually moved
+    assert losses_j[-1] != losses_j[0]

@@ -52,10 +52,12 @@ def test_sharded_rollout_and_train_step(dp_setup):
 
     rs, batch, stats = rollout(ts.learner.params["agent"], ts.runner,
                                test_mode=False)
-    # env lanes stay sharded across the data axis
-    assert batch.obs.shape[0] == 8
-    assert not batch.obs.sharding.is_fully_replicated
-    assert len(batch.obs.sharding.device_set) == 8
+    # env lanes stay sharded across the data axis (obs is a
+    # CompactEntityObs pytree under the default fast-path stack)
+    obs_leaf = jax.tree.leaves(batch.obs)[0]
+    assert obs_leaf.shape[0] == 8
+    assert not obs_leaf.sharding.is_fully_replicated
+    assert len(obs_leaf.sharding.device_set) == 8
     ts = ts.replace(runner=rs, buffer=insert(ts.buffer, batch),
                     episode=ts.episode + cfg.batch_size_run)
 

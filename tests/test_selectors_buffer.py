@@ -153,3 +153,28 @@ def test_per_new_episodes_get_max_priority():
     s = buf.update_priorities(s, jnp.arange(2), jnp.asarray([5.0, 1.0]))
     s = buf.insert_episode_batch(s, _make_batch(1))
     assert float(s.priorities[2]) == pytest.approx(5.0)   # running max
+
+
+def test_avail_actions_storage_is_bool():
+    """avail is a predicate: bool ring storage makes arithmetic misuse a
+    type error (consumers only ever compare > 0)."""
+    import jax
+    from t2omca_tpu.config import EnvConfig, ModelConfig, ReplayConfig, \
+        TrainConfig, sanity_check
+    from t2omca_tpu.run import Experiment
+    cfg = sanity_check(TrainConfig(
+        batch_size_run=2,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=4),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1),
+        replay=ReplayConfig(buffer_size=8)))
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(0)
+    assert ts.buffer.storage.avail_actions.dtype == jnp.bool_
+    rollout, insert, _ = exp.jitted_programs()
+    _, batch, _ = rollout(ts.learner.params["agent"], ts.runner,
+                          test_mode=False)
+    assert batch.avail_actions.dtype == jnp.bool_
+    buf = insert(ts.buffer, batch)
+    assert buf.storage.avail_actions.dtype == jnp.bool_
