@@ -31,7 +31,11 @@ def _series(key, root=None, run_glob="qmix*"):
     (ROOT, "qmix*"),                                     # dense-path run
     (os.path.join(RUNS, "config1_qslice"), "qmix*seed4*"),
     (os.path.join(RUNS, "config1_faststack"), "qmix*seed4*"),
-], ids=["dense", "qslice", "faststack"])
+    # the round-4 stability sweep (new default hypers): worst-case AND
+    # best committed seeds — the gate covers more than one seed
+    (os.path.join(RUNS, "config1_stable"), "qmix*seed0*"),
+    (os.path.join(RUNS, "config1_stable"), "qmix*seed3*"),
+], ids=["dense", "qslice", "faststack", "stable-s0", "stable-s3"])
 def test_final_test_return_beats_random_baseline(root, run_glob):
     """One gate, three committed artifacts: the last-3-eval mean must beat
     the measured random baseline by > 2σ of its spread."""
