@@ -1,0 +1,61 @@
+"""The bench harness itself is a round artifact producer (the driver runs
+``python bench.py`` on TPU and records its ONE JSON line in BENCH_r{N}.json)
+— so its output contract is pinned here, on the CPU smoke path, where a
+harness regression would otherwise only be discovered on the chip."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def run_bench(*args):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # CPU run must not touch axon
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke", *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE JSON line, got: {proc.stdout!r}"
+    return json.loads(lines[0])
+
+
+def test_default_line_schema():
+    rec = run_bench()
+    for k in ("metric", "value", "unit", "vs_baseline"):
+        assert k in rec, rec
+    assert rec["metric"] == "env_steps_per_sec"
+    assert rec["unit"] == "env-steps/s/chip"
+    assert isinstance(rec["value"], (int, float)) and rec["value"] > 0
+    # smoke runs must not claim a BASELINE config id
+    assert rec["config"] is None
+
+
+@pytest.mark.parametrize("acting", ["qslice", "dense"])
+def test_acting_selector_reported(acting):
+    rec = run_bench("--acting", acting)
+    assert rec["acting"] == acting
+    assert rec["value"] > 0
+
+
+def test_committed_config_presets_load():
+    """The configs/ presets (BASELINE measurement points as config files —
+    the reference's sacred-config workflow, M14) must stay loadable and
+    sane as flags evolve."""
+    from t2omca_tpu.config import load_config
+    expect = {
+        "config1_cpu_parity.yaml": dict(agv=4, envs=8, dp=0),
+        "config3_tpu_northstar.yaml": dict(agv=64, envs=1024, dp=0),
+        "config5_dp8.yaml": dict(agv=256, envs=8192, dp=8),
+    }
+    for name, e in expect.items():
+        cfg = load_config(os.path.join(REPO, "configs", name))
+        assert cfg.env_args.agv_num == e["agv"]
+        assert cfg.batch_size_run == e["envs"]
+        assert cfg.dp_devices == e["dp"]
