@@ -1,0 +1,70 @@
+"""Worker for the multi-process (multi-host leg) test — NOT a test module.
+
+Launched twice by ``test_multihost.py`` with the standard JAX topology
+env vars (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/
+``JAX_PROCESS_ID``) set, exactly the scheduler contract
+``parallel.distributed.maybe_initialize_distributed`` consumes in
+production (wired at ``t2omca_tpu/__main__.py``). Each process owns 4
+virtual CPU devices; the global mesh spans both processes, so the data
+axis crosses the process boundary and every collective in the train step
+takes the DCN leg (gloo on CPU; ICI/DCN on a real pod)."""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+# CPU cross-process collectives backend (jaxlib ships gloo); a TPU pod
+# uses the ICI/DCN fabric instead, so this stays test-side
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
+def main() -> int:
+    import jax.numpy as jnp
+
+    from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,
+                                   TrainConfig, sanity_check)
+    from t2omca_tpu.parallel import (DataParallel, make_mesh,
+                                     maybe_initialize_distributed)
+    from t2omca_tpu.run import Experiment
+
+    assert maybe_initialize_distributed(), "topology env vars must be set"
+    assert jax.device_count() == 8, jax.device_count()
+    assert len(jax.local_devices()) == 4
+
+    cfg = sanity_check(TrainConfig(
+        batch_size_run=8, batch_size=8,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=4),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1),
+        replay=ReplayConfig(buffer_size=16),
+    ))
+    exp = Experiment.build(cfg)
+    mesh = make_mesh(8)
+    dp = DataParallel(exp, mesh)
+    # every process computes the identical initial state (same seed);
+    # shard() places each process's local shards of the global arrays
+    ts = dp.shard(exp.init_train_state(0))
+    rollout, insert, train_iter = dp.jitted_programs()
+
+    rs, batch, _ = rollout(ts.learner.params["agent"], ts.runner,
+                           test_mode=False)
+    obs_leaf = jax.tree.leaves(batch.obs)[0]
+    assert len(obs_leaf.sharding.device_set) == 8, "episode axis not global"
+    ts = ts.replace(runner=rs, buffer=insert(ts.buffer, batch),
+                    episode=ts.episode + cfg.batch_size_run)
+    ts, info = train_iter(ts, jax.random.PRNGKey(1), jnp.asarray(32))
+    loss = float(jax.device_get(info["loss"]))
+    assert jnp.isfinite(loss)
+    leaf = jax.tree.leaves(ts.learner.params)[0]
+    assert leaf.sharding.is_fully_replicated, "params must stay replicated"
+    # the parent compares this line across both processes: identical loss
+    # proves the gradient psum crossed the process boundary coherently
+    print(f"LOSS {loss:.10f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+"""The multi-host (DCN) leg, actually multi-process (SURVEY.md §2.2
+'Communication backend'; A8): two OS processes x 4 virtual CPU devices
+each form one 8-device global mesh via ``maybe_initialize_distributed``
+(the production entry, driven by the standard topology env vars), and the
+full rollout -> insert -> train step runs sharded ACROSS the process
+boundary — the gradient psum rides the cross-process collective backend.
+
+This is the strongest distributed evidence available without a pod: the
+same code path on a TPU pod only swaps gloo for ICI/DCN."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_train_step_agrees():
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.abspath(REPO)]
+            + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_COORDINATOR_ADDRESS"] = f"localhost:{port}"
+        env["JAX_NUM_PROCESSES"] = "2"
+        env["JAX_PROCESS_ID"] = str(pid)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join("tests", "mp_worker.py")],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=900)
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(out)
+
+    losses = []
+    for out in outs:
+        lines = [l for l in out.splitlines() if l.startswith("LOSS ")]
+        assert len(lines) == 1, out
+        losses.append(float(lines[0].split()[1]))
+    # identical loss on both processes: the psum crossed the boundary
+    np.testing.assert_allclose(losses[0], losses[1], rtol=0, atol=0)
