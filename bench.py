@@ -364,6 +364,9 @@ def main() -> int:
                     help="benchmark the learner: train_iter (PER sample -> "
                          "train -> priority update) and the interleaved "
                          "rollout+train loop (BASELINE.json config 4)")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize learner scan forwards in the "
+                         "backward pass (long-horizon HBM lever; exact)")
     ap.add_argument("--heads", type=int, default=4,
                     help="agent/mixer head count (d256 standard heads: 4 -> "
                          "head_dim 64, 2 -> head_dim 128 = full MXU lanes)")
@@ -440,6 +443,7 @@ def main() -> int:
                               # the learner trains through it regardless of
                               # the acting kernel (QMixLearner._agent_qslice)
                               use_qslice=args.acting != "dense",
+                              remat=args.remat,
                               pallas_tile=args.tile),
             replay=ReplayConfig(buffer_size=4, store_dtype="bfloat16"),
         ))

@@ -87,6 +87,11 @@ class ModelConfig:
     # materializing per-agent token embeddings; exact for entity-mode obs
     # under fast_norm, auto-disabled otherwise
     use_entity_tables: bool = True
+    # rematerialize the learner's per-timestep forwards in the backward
+    # pass (jax.checkpoint around the scan bodies): trades ~1 extra
+    # forward for O(T) less residual HBM — the standard TPU lever for
+    # long-horizon episode unrolls (config 3/4: T=150)
+    remat: bool = False
     # entity counts: filled from env info when 0
     n_entities_obs: int = 0
     n_entities_state: int = 0

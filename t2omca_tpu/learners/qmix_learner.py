@@ -137,6 +137,15 @@ class QMixLearner:
         from ..ops.query_slice import mixer_qslice_eligible
         return mixer_qslice_eligible(self.cfg)
 
+
+    def _scan_body(self, body):
+        """Wrap a scan body with jax.checkpoint when ``model.remat``: the
+        backward pass then recomputes each timestep's forward instead of
+        keeping O(T) residuals — the long-horizon HBM lever (exact: same
+        values, same gradients)."""
+        import jax as _jax
+        return _jax.checkpoint(body) if self.cfg.model.remat else body
+
     @property
     def needs_rngs(self) -> bool:
         """True when training must sample noise/dropout masks: NoisyNet
@@ -170,7 +179,7 @@ class QMixLearner:
                 return h, (q, h)
 
             _, (qs, hs) = jax.lax.scan(
-                body, self.mac.init_hidden(b), compact_tm)
+                self._scan_body(body), self.mac.init_hidden(b), compact_tm)
             return qs, hs
 
         b = obs_tm.shape[1]
@@ -195,7 +204,8 @@ class QMixLearner:
                 q, h = fwd(agent_params, obs_t, h)
                 return h, (q, h)
 
-            _, (qs, hs) = jax.lax.scan(body, self.mac.init_hidden(b), obs_tm)
+            _, (qs, hs) = jax.lax.scan(self._scan_body(body),
+                                       self.mac.init_hidden(b), obs_tm)
         else:
             def body(h, xs):
                 obs_t, k_t = xs
@@ -205,7 +215,8 @@ class QMixLearner:
 
             keys = jax.random.split(key, obs_tm.shape[0])
             _, (qs, hs) = jax.lax.scan(
-                body, self.mac.init_hidden(b), (obs_tm, keys))
+                self._scan_body(body), self.mac.init_hidden(b),
+                (obs_tm, keys))
         return qs, hs
 
     def _unroll_mixer(self, mixer_params, q_tm: jnp.ndarray,
@@ -232,7 +243,7 @@ class QMixLearner:
                 return hyper, q_tot[:, 0, 0]
 
             _, q_tots = jax.lax.scan(
-                body, self.mixer.initial_hyper(b),
+                self._scan_body(body), self.mixer.initial_hyper(b),
                 (q_tm, hid_tm, state_tm, obs_tm))
         else:
             def body(hyper, xs):
@@ -244,7 +255,7 @@ class QMixLearner:
 
             keys = jax.random.split(key, q_tm.shape[0])
             _, q_tots = jax.lax.scan(
-                body, self.mixer.initial_hyper(b),
+                self._scan_body(body), self.mixer.initial_hyper(b),
                 (q_tm, hid_tm, state_tm, obs_tm, keys))
         return q_tots
 

@@ -259,3 +259,26 @@ def test_mixer_monotonic_in_agent_qs(setup):
 
     g = jax.grad(qtot)(qvals)
     assert (np.asarray(g) >= 0).all()
+
+
+def test_remat_is_exact(setup):
+    """model.remat recomputes forwards in the backward pass — a
+    memory/compute trade, not an approximation: the loss is identical and
+    gradients agree to f32 recompute-reassociation noise (XLA may fuse
+    the recomputed forward differently)."""
+    import dataclasses
+
+    cfg, env, info, mac, learner, runner, ls, rs, run = setup
+    _, batch, _ = run(ls.params["agent"], rs, test_mode=False)
+    w = jnp.ones((cfg.batch_size_run,))
+
+    cfg_r = cfg.replace(model=dataclasses.replace(cfg.model, remat=True))
+    learner_r = QMixLearner.build(cfg_r, mac, info)
+
+    (l0, i0), g0 = jax.value_and_grad(learner._loss, has_aux=True)(
+        ls.params, ls.target_params, batch, w)
+    (l1, i1), g1 = jax.value_and_grad(learner_r._loss, has_aux=True)(
+        ls.params, ls.target_params, batch, w)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4), g0, g1)
