@@ -384,20 +384,45 @@ def main() -> int:
     import jax.numpy as jnp
 
     if not args.smoke:
-        # probe the backend FIRST: a wedged/unavailable TPU tunnel (see
-        # BASELINE.md axon note) should yield a parseable record, not a
-        # bare traceback with no JSON line
-        try:
-            jax.devices()
-        except RuntimeError as e:
-            metric, unit = (("train_steps_per_sec", "train-steps/s/chip")
-                            if args.train
-                            else ("env_steps_per_sec", "env-steps/s/chip"))
+        # probe the backend FIRST, and time-bound the probe: a wedged
+        # axon tunnel blocks backend init ~25 min before erroring (see
+        # BASELINE.md), which can outlast the caller's own timeout — the
+        # record must land BEFORE that. A healthy init is seconds; the
+        # bound only fires on a dead tunnel, where no claim is held yet,
+        # so exiting cannot wedge the remote further.
+        import os
+        import threading
+        metric, unit = (("train_steps_per_sec", "train-steps/s/chip")
+                        if args.train
+                        else ("env_steps_per_sec", "env-steps/s/chip"))
+
+        def _error_record(msg: str) -> None:
             print(json.dumps({
                 "metric": metric, "value": None,
                 "unit": unit, "vs_baseline": None,
-                "error": f"backend unavailable: {e}"[:500],
-            }))
+                "error": msg[:500],
+            }), flush=True)
+
+        probe_s = float(os.environ.get("T2OMCA_BACKEND_PROBE_TIMEOUT",
+                                       "900"))
+        result = {}
+
+        def _probe():
+            try:
+                jax.devices()
+                result["ok"] = True
+            except RuntimeError as e:
+                result["error"] = str(e)
+
+        th = threading.Thread(target=_probe, daemon=True)
+        th.start()
+        th.join(timeout=probe_s if probe_s > 0 else 0)
+        if probe_s <= 0 or th.is_alive():
+            _error_record(f"backend init exceeded {probe_s:.0f}s probe "
+                          f"bound (wedged tunnel?)")
+            os._exit(1)      # the blocked init thread cannot be joined
+        if "error" in result:
+            _error_record(f"backend unavailable: {result['error']}")
             return 1
 
     from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,

@@ -59,3 +59,20 @@ def test_committed_config_presets_load():
         assert cfg.env_args.agv_num == e["agv"]
         assert cfg.batch_size_run == e["envs"]
         assert cfg.dp_devices == e["dp"]
+
+
+def test_backend_probe_bound_emits_record():
+    """A wedged TPU tunnel blocks backend init far past the caller's own
+    timeout — the probe bound must land a parseable error record first
+    (probe timeout <= 0 forces the timed-out branch deterministically)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["T2OMCA_BACKEND_PROBE_TIMEOUT"] = "0"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--envs", "8", "--steps", "2"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["value"] is None
+    assert "probe bound" in rec["error"]
