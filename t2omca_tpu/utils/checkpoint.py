@@ -97,7 +97,7 @@ def load_checkpoint(dirname: str, target: Any) -> Any:
     with open(os.path.join(dirname, "state.msgpack"), "rb") as f:
         data = f.read()
     try:
-        return serialization.from_bytes(target, data)
+        restored = serialization.from_bytes(target, data)
     except (KeyError, ValueError) as e:
         raise ValueError(
             f"checkpoint {dirname} does not match the configured train-state "
@@ -105,3 +105,36 @@ def load_checkpoint(dirname: str, target: Any) -> Any:
             f"checkpoints written before/after the compact entity storage "
             f"default need replay.compact_entity_store toggled to match "
             f"(docs/SPEC.md perf modes)") from e
+    # flax does not shape-validate on restore: a checkpoint from a
+    # different config (env lanes, replay capacity, DP shapes) would
+    # silently land wrong-shaped arrays that only explode later inside
+    # jit — reject it here so callers can fall back to the model-only
+    # restore (run.evaluate_sequential does)
+    t_leaves = jax.tree_util.tree_leaves_with_path(target)
+    r_leaves = jax.tree_util.tree_leaves_with_path(restored)
+    bad = [
+        (jax.tree_util.keystr(kp), getattr(lt, "shape", None),
+         getattr(lr, "shape", None))
+        for (kp, lt), (_, lr) in zip(t_leaves, r_leaves)
+        if getattr(lt, "shape", None) != getattr(lr, "shape", None)]
+    if bad:
+        k, st, sr = bad[0]
+        raise ValueError(
+            f"checkpoint {dirname} was written under a different config: "
+            f"{len(bad)} leaves mismatch the template (first: {k} stored "
+            f"{sr} vs configured {st}). Use load_learner_state for "
+            f"model-only restore (reference semantics).")
+    return restored
+
+
+def load_learner_state(dirname: str, target: Any) -> Any:
+    """Restore ONLY the learner subtree (params/target/optimizer) into a
+    full train-state template — shape-independent of the runner/replay
+    config, so a model trained at one scale (or on a DP mesh) evaluates
+    under any other. Matches the reference's model-only checkpoint
+    semantics (``/root/reference/per_run.py:185-187``): runner-side
+    normalizer statistics start fresh."""
+    with open(os.path.join(dirname, "state.msgpack"), "rb") as f:
+        raw = serialization.msgpack_restore(f.read())
+    learner = serialization.from_state_dict(target.learner, raw["learner"])
+    return target.replace(learner=learner)
