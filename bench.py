@@ -318,6 +318,80 @@ def bench_train(cfg, _time, args) -> int:
     return 0
 
 
+def bench_hbm(cfg, args) -> int:
+    """``--hbm``: analytic device-memory budget for a config — sizes the
+    dominant residents (replay ring, in-flight episode batch, learner scan
+    residuals) from shapes alone, so OOM surprises are caught before a
+    chip run. Estimates, not measurements: XLA adds workspace and
+    fragmentation on top."""
+    import math
+
+    from t2omca_tpu.envs.registry import make_env
+    from t2omca_tpu.ops.query_slice import entity_store_eligible
+
+    env = make_env(cfg.env_args)
+    info = env.get_env_info()
+    a = info["n_agents"]
+    obs_dim, state_dim = info["obs_shape"], info["state_shape"]
+    n_act = info["n_actions"]
+    t = cfg.env_args.episode_limit
+    f = info["obs_entity_feats"]
+    sd = 2 if cfg.replay.store_dtype == "bfloat16" else 4
+    cd = 2 if cfg.model.dtype == "bfloat16" else 4
+    compact = entity_store_eligible(cfg)
+
+    def episode_bytes(batch):
+        if compact:
+            obs = batch * (t + 1) * a * ((f - 1) * 4 + 1 + 2 * f * 4)
+        else:
+            obs = batch * (t + 1) * a * obs_dim * sd
+        state = batch * (t + 1) * state_dim * sd
+        avail = batch * (t + 1) * a * n_act
+        small = batch * t * (a * 4 + 4 + 1 + 1)
+        return obs + state + avail + small
+
+    ring = episode_bytes(cfg.replay.buffer_size)
+    rollout_batch = episode_bytes(cfg.batch_size_run)
+    train_batch = episode_bytes(cfg.batch_size)
+
+    # learner backward residuals: per timestep each unrolled forward keeps
+    # O(tokens · emb) activations per block for the VJP unless remat is on
+    emb = cfg.model.emb
+    tokens_agent = 2 if compact else (a + 1)   # entity tables: folded rows
+    act_per_step = (cfg.batch_size * a * tokens_agent * emb * cd
+                    * cfg.model.depth * (2 + cfg.model.ff_hidden_mult))
+    mixer_tokens = a + 3 + info["n_entities"]
+    mix_per_step = (cfg.batch_size * mixer_tokens * cfg.model.mixer_emb * cd
+                    * cfg.model.mixer_depth * (2 + cfg.model.ff_hidden_mult))
+    residuals = (t + 1) * (act_per_step + mix_per_step)
+    if cfg.model.remat:
+        residuals = act_per_step + mix_per_step   # one step live at a time
+
+    rows = {
+        "replay_ring": ring,
+        "rollout_episode_batch": rollout_batch,
+        "train_episode_batch": train_batch,
+        "learner_scan_residuals": residuals,
+    }
+    total = sum(rows.values())
+    gib = 1024 ** 3
+    for k, v in rows.items():
+        print(f"# {k:24s} {v / gib:8.3f} GiB", file=sys.stderr)
+    print(f"# {'total (est.)':24s} {total / gib:8.3f} GiB "
+          f"(storage={'compact' if compact else 'dense'}, "
+          f"remat={'on' if cfg.model.remat else 'off'}; excludes XLA "
+          f"workspace/fragmentation)", file=sys.stderr)
+    print(json.dumps({
+        "metric": "hbm_estimate_gib",
+        "value": round(total / gib, 3),
+        "unit": "GiB",
+        "vs_baseline": None,
+        "config": None if args.envs or args.steps else args.config,
+        "breakdown_gib": {k: round(v / gib, 3) for k, v in rows.items()},
+    }))
+    return 0
+
+
 #: BASELINE.json measurement scale points (see BASELINE.md §configs):
 #: (agv, mec, channels, envs, d_model, depth) — config 4 adds PER scale,
 #: config 5 is the DP=8 point (needs ≥8 devices; compile-checked by the
@@ -364,6 +438,9 @@ def main() -> int:
                     help="benchmark the learner: train_iter (PER sample -> "
                          "train -> priority update) and the interleaved "
                          "rollout+train loop (BASELINE.json config 4)")
+    ap.add_argument("--hbm", action="store_true",
+                    help="print the analytic device-memory budget for the "
+                         "selected config (no device work)")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize learner scan forwards in the "
                          "backward pass (long-horizon HBM lever; exact)")
@@ -376,14 +453,16 @@ def main() -> int:
     if args.no_pallas:
         args.acting = "dense"
 
-    if args.smoke:
+    if args.smoke or args.hbm:
+        # --hbm is pure shape arithmetic: never touch a (possibly wedged)
+        # TPU backend for it
         import jax
         jax.config.update("jax_platforms", "cpu")
 
     import jax
     import jax.numpy as jnp
 
-    if not args.smoke:
+    if not args.smoke and not args.hbm:
         # probe the backend FIRST, and time-bound the probe: a wedged
         # axon tunnel blocks backend init ~25 min before erroring (see
         # BASELINE.md), which can outlast the caller's own timeout — the
@@ -505,6 +584,9 @@ def main() -> int:
             jax.profiler.stop_trace()
             print(f"# trace written to {args.profile}", file=sys.stderr,
                   flush=True)
+
+    if args.hbm:
+        return bench_hbm(cfg, args)
 
     if args.config == 5 and not args.smoke:
         # the DP=8 scale point has its own program shape (sharded mesh);

@@ -76,3 +76,22 @@ def test_backend_probe_bound_emits_record():
     rec = json.loads(proc.stdout.strip().splitlines()[-1])
     assert rec["value"] is None
     assert "probe bound" in rec["error"]
+
+
+def test_hbm_estimator_schema_and_no_device_work():
+    """--hbm is pure shape arithmetic — it must work with the axon env
+    var present (never touching a possibly-wedged backend) and report the
+    compact-vs-dense storage difference."""
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = "127.0.0.1"   # wedged-tunnel conditions
+    env["T2OMCA_BACKEND_PROBE_TIMEOUT"] = "1"   # would fail if probed
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--hbm", "--config", "3"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "hbm_estimate_gib"
+    assert rec["value"] > 0
+    assert set(rec["breakdown_gib"]) == {
+        "replay_ring", "rollout_episode_batch", "train_episode_batch",
+        "learner_scan_residuals"}
