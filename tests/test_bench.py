@@ -95,3 +95,26 @@ def test_hbm_estimator_schema_and_no_device_work():
     assert set(rec["breakdown_gib"]) == {
         "replay_ring", "rollout_episode_batch", "train_episode_batch",
         "learner_scan_residuals"}
+
+
+def test_dp_bench_path_on_virtual_mesh():
+    """The --config 5 (DP=8) bench is the config-5 round-artifact
+    producer: run it at reduced shapes on the 8-device virtual CPU mesh
+    and check both metric halves appear (rollout env-steps/s headline +
+    train-steps/s field)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_NUM_CPU_DEVICES"] = "8"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--config", "5", "--envs", "8",
+         "--steps", "2", "--iters", "1"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "env_steps_per_sec"
+    assert rec["dp"] == 8
+    assert rec["value"] > 0
+    assert rec["train_steps_per_sec"] > 0
+    # reduced shapes must not claim the BASELINE scale point
+    assert rec["config"] is None
