@@ -392,6 +392,92 @@ def bench_hbm(cfg, args) -> int:
     return 0
 
 
+def bench_all(make_cfg, _time, args) -> int:
+    """``--all``: the full single-chip measurement set in ONE process —
+    one backend init total, for tunnel-scarce conditions (BASELINE.md
+    axon note). Emits one JSON line per measurement, most important
+    first, so a mid-run death still leaves the headline on stdout."""
+    import gc
+
+    import jax
+
+    from t2omca_tpu.run import Experiment
+
+    def emit(rec):
+        print(json.dumps(rec), flush=True)
+
+    def rollout_rate(cfg, label, extra=None):
+        exp = Experiment.build(cfg)
+        ts = exp.init_train_state(0)
+        rollout = jax.jit(exp.runner.run, static_argnames="test_mode")
+        params = ts.learner.params["agent"]
+        rs, batch, _ = rollout(params, ts.runner, test_mode=False)
+
+        def one():
+            _, b, _ = rollout(params, rs, test_mode=False)
+            return b.reward[0, 0]
+
+        dt = _time(one)
+        env_steps = cfg.batch_size_run * cfg.env_args.episode_limit
+        rec = {
+            "metric": "env_steps_per_sec",
+            "value": round(env_steps / dt, 1),
+            "unit": "env-steps/s/chip",
+            "vs_baseline": round(env_steps / dt / 50_000.0, 3),
+            "acting": label,
+            "n_envs": cfg.batch_size_run,
+            "episode_steps": cfg.env_args.episode_limit,
+        }
+        if extra:
+            rec.update(extra)
+        return rec
+
+    # only claim a BASELINE scale point when unmodified
+    cid = lambda n: None if args.envs or args.steps else n
+
+    # 1. headline: config 3, production acting path, both metric halves
+    cfg3 = make_cfg("qslice", 3)
+    rec = rollout_rate(cfg3, "entity/qslice", {"config": cid(3)})
+    try:
+        rec.update(_train_numbers(cfg3, _time))
+    except Exception as e:                  # pragma: no cover - defensive
+        print(f"# train half failed: {e!r}", file=sys.stderr)
+    emit(rec)
+    gc.collect()
+
+    # 2. config 4 train scale (PER + 4096 envs interleave)
+    try:
+        cfg4 = make_cfg("qslice", 4)
+        nums = _train_numbers(cfg4, _time)
+        emit({"metric": "train_steps_per_sec",
+              "value": nums["train_steps_per_sec"],
+              "unit": "train-steps/s/chip", "vs_baseline": None,
+              "config": cid(4),
+              "interleaved_env_steps_per_sec":
+                  nums["interleaved_env_steps_per_sec"]})
+    except Exception as e:                  # pragma: no cover - defensive
+        print(f"# config-4 train failed: {e!r}", file=sys.stderr)
+    gc.collect()
+
+    # 3. acting-path comparison at config 3 (the Pallas-fate data,
+    #    VERDICT r3 task 8)
+    for label in ("pallas", "dense"):
+        try:
+            emit(rollout_rate(make_cfg(label, 3), label,
+                              {"config": cid(3)}))
+        except Exception as e:              # pragma: no cover - defensive
+            print(f"# {label} rollout failed: {e!r}", file=sys.stderr)
+        gc.collect()
+
+    # 4. breakdown attribution at config 3 (its own JSON line)
+    try:
+        exp = Experiment.build(cfg3)
+        breakdown(cfg3, exp, exp.init_train_state(0), _time, args)
+    except Exception as e:                  # pragma: no cover - defensive
+        print(f"# breakdown failed: {e!r}", file=sys.stderr)
+    return 0
+
+
 #: BASELINE.json measurement scale points (see BASELINE.md §configs):
 #: (agv, mec, channels, envs, d_model, depth) — config 4 adds PER scale,
 #: config 5 is the DP=8 point (needs ≥8 devices; compile-checked by the
@@ -438,6 +524,12 @@ def main() -> int:
                     help="benchmark the learner: train_iter (PER sample -> "
                          "train -> priority update) and the interleaved "
                          "rollout+train loop (BASELINE.json config 4)")
+    ap.add_argument("--all", action="store_true",
+                    help="comprehensive single-process sweep: default "
+                         "rollout+train line, breakdown, pallas/dense "
+                         "comparison, config-4 scale — one backend init, "
+                         "one JSON line per measurement (tunnel-scarce "
+                         "mode)")
     ap.add_argument("--hbm", action="store_true",
                     help="print the analytic device-memory budget for the "
                          "selected config (no device work)")
@@ -528,29 +620,34 @@ def main() -> int:
         # per-step; the full 150-slot episode batch at entity obs 64×576
         # would exceed single-chip HBM — the training config shards it over
         # the data axis instead).
-        c = _CONFIGS[args.config]
-        n_envs = args.envs or c["envs"]
-        steps = args.steps or 32
-        cfg = sanity_check(TrainConfig(
-            batch_size_run=n_envs,
-            env_args=EnvConfig(agv_num=c["agv"], mec_num=c["mec"],
-                               num_channels=c["ch"],
-                               episode_limit=steps,
-                               fast_norm=not args.no_fast_norm),
-            model=ModelConfig(emb=c["emb"], heads=args.heads,
-                              depth=c["depth"],
-                              mixer_emb=c["emb"], mixer_heads=args.heads,
-                              mixer_depth=c["depth"],
-                              standard_heads=True, dtype="bfloat16",
-                              use_pallas=args.acting == "pallas",
-                              # production pallas configs leave qslice on —
-                              # the learner trains through it regardless of
-                              # the acting kernel (QMixLearner._agent_qslice)
-                              use_qslice=args.acting != "dense",
-                              remat=args.remat,
-                              pallas_tile=args.tile),
-            replay=ReplayConfig(buffer_size=4, store_dtype="bfloat16"),
-        ))
+        def make_cfg(acting: str, config_id: int):
+            c = _CONFIGS[config_id]
+            return sanity_check(TrainConfig(
+                batch_size_run=args.envs or c["envs"],
+                env_args=EnvConfig(agv_num=c["agv"], mec_num=c["mec"],
+                                   num_channels=c["ch"],
+                                   episode_limit=args.steps or 32,
+                                   fast_norm=not args.no_fast_norm),
+                model=ModelConfig(emb=c["emb"], heads=args.heads,
+                                  depth=c["depth"],
+                                  mixer_emb=c["emb"],
+                                  mixer_heads=args.heads,
+                                  mixer_depth=c["depth"],
+                                  standard_heads=True, dtype="bfloat16",
+                                  use_pallas=acting == "pallas",
+                                  # production pallas configs leave qslice
+                                  # on — the learner trains through it
+                                  # regardless of the acting kernel
+                                  # (QMixLearner._agent_qslice)
+                                  use_qslice=acting != "dense",
+                                  remat=args.remat,
+                                  pallas_tile=args.tile),
+                replay=ReplayConfig(buffer_size=4, store_dtype="bfloat16"),
+            ))
+
+        cfg = make_cfg(args.acting, args.config)
+        n_envs = cfg.batch_size_run
+        steps = cfg.env_args.episode_limit
 
     import numpy as np
 
@@ -587,6 +684,12 @@ def main() -> int:
 
     if args.hbm:
         return bench_hbm(cfg, args)
+
+    if args.all:
+        if args.smoke:
+            raise SystemExit("--all is a full-scale chip mode; drop --smoke")
+        with tracing():
+            return bench_all(make_cfg, _time, args)
 
     if args.config == 5 and not args.smoke:
         # the DP=8 scale point has its own program shape (sharded mesh);
