@@ -688,6 +688,14 @@ def main() -> int:
     if args.all:
         if args.smoke:
             raise SystemExit("--all is a full-scale chip mode; drop --smoke")
+        if (args.config != 3 or args.acting != "qslice" or args.train
+                or args.breakdown):
+            # --all owns its measurement matrix; silently ignoring these
+            # would misattribute records
+            raise SystemExit(
+                "--all runs its own fixed measurement set (config-3 "
+                "headline + config-4 train + pallas/dense + breakdown); "
+                "drop --config/--acting/--train/--breakdown")
         with tracing():
             return bench_all(make_cfg, _time, args)
 

@@ -379,15 +379,20 @@ def evaluate_sequential(exp: Experiment, logger: Logger,
         found = find_checkpoint(cfg.checkpoint_path, cfg.load_step)
         if found is not None:
             dirname, step = found
+            from .utils.checkpoint import (CheckpointFormatError,
+                                           load_learner_state)
             try:
                 ts = load_checkpoint(dirname, ts)
                 log.info(f"loaded full state from {dirname}")
-            except ValueError:
+            except CheckpointFormatError:
+                raise        # unreadable format: no fallback applies
+            except ValueError as e:
                 # eval config differs from the training config (other
                 # env-lane count, dense-vs-compact replay, DP shapes):
                 # fall back to the learner subtree — the reference's
                 # model-only checkpoint semantics (per_run.py:185-187)
-                from .utils.checkpoint import load_learner_state
+                log.info(f"full-state restore rejected ({e}); trying "
+                         f"model-only restore")
                 ts = load_learner_state(dirname, ts)
                 log.info(f"loaded learner (model-only) from {dirname}; "
                          f"runner state starts fresh")

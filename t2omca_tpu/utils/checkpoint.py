@@ -28,6 +28,11 @@ from flax import serialization
 FORMAT_VERSION = 2
 
 
+class CheckpointFormatError(ValueError):
+    """The checkpoint's on-disk format is not readable by this build
+    (newer FORMAT_VERSION). NOT a config mismatch — no fallback applies."""
+
+
 def _obs_layout(state: Any) -> Optional[str]:
     """'compact' | 'dense' | None (host buffer keeps state outside the tree)."""
     from ..components.episode_buffer import CompactEntityObs
@@ -80,7 +85,7 @@ def load_checkpoint(dirname: str, target: Any) -> Any:
             meta = json.load(f)
         fmt = meta.get("format", 0)
         if fmt > FORMAT_VERSION:
-            raise ValueError(
+            raise CheckpointFormatError(
                 f"checkpoint {dirname} has format v{fmt}, newer than this "
                 f"build's v{FORMAT_VERSION} — upgrade the framework to "
                 f"restore it")
@@ -137,4 +142,21 @@ def load_learner_state(dirname: str, target: Any) -> Any:
     with open(os.path.join(dirname, "state.msgpack"), "rb") as f:
         raw = serialization.msgpack_restore(f.read())
     learner = serialization.from_state_dict(target.learner, raw["learner"])
+    # same silent-wrong-shape hazard as the full restore: a model-config
+    # mismatch (e.g. different emb) must fail HERE with the leaf named,
+    # not later inside jit — and for params there is no further fallback
+    t_leaves = jax.tree_util.tree_leaves_with_path(target.learner)
+    r_leaves = jax.tree_util.tree_leaves_with_path(learner)
+    bad = [
+        (jax.tree_util.keystr(kp), getattr(lt, "shape", None),
+         getattr(lr, "shape", None))
+        for (kp, lt), (_, lr) in zip(t_leaves, r_leaves)
+        if getattr(lt, "shape", None) != getattr(lr, "shape", None)]
+    if bad:
+        k, st, sr = bad[0]
+        raise ValueError(
+            f"checkpoint {dirname} holds a different MODEL than the "
+            f"configured one: {len(bad)} learner leaves mismatch (first: "
+            f"{k} stored {sr} vs configured {st}); fix the model config "
+            f"to match the checkpoint")
     return target.replace(learner=learner)

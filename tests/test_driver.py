@@ -231,3 +231,22 @@ def test_dp_checkpoint_evaluates_under_other_configs(tmp_path):
                         evaluate=True, test_nepisode=2,
                         checkpoint_path=model_dir)
     run(cfg_eval, Logger())
+
+
+def test_model_only_restore_rejects_different_model(tmp_path):
+    """load_learner_state must fail with the leaf named when the MODEL
+    config mismatches (there is no further fallback — silent wrong-shape
+    params would only explode later inside jit)."""
+    from t2omca_tpu.utils.checkpoint import (load_learner_state,
+                                             save_checkpoint)
+
+    cfg = tiny_cfg(tmp_path)
+    exp = Experiment.build(cfg)
+    d = save_checkpoint(str(tmp_path / "ck"), 10, exp.init_train_state(0))
+
+    cfg_big = tiny_cfg(tmp_path, model=ModelConfig(
+        emb=16, heads=2, depth=1, mixer_emb=16, mixer_heads=2,
+        mixer_depth=1))
+    exp_big = Experiment.build(cfg_big)
+    with pytest.raises(ValueError, match="different MODEL"):
+        load_learner_state(d, exp_big.init_train_state(0))
