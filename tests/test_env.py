@@ -365,3 +365,56 @@ def test_state_last_action_flag():
     st2, _, _, _, _, gstate, _ = env.step(st, actions, jax.random.PRNGKey(1))
     la = np.asarray(gstate[:4 * env.n_actions]).reshape(4, env.n_actions)
     np.testing.assert_allclose(la, np.eye(env.n_actions)[np.asarray(actions)])
+
+
+def test_fuzz_invariants_over_random_trajectories():
+    """Structural invariants under 3 seeds x 60 random (legal) steps:
+    whatever the action sequence, the state must stay well-formed —
+    counters monotone and ordered, queue entries consistent, positions
+    finite and inside the deployment disc, normalizer stats sane. Guards
+    the queue pop->age->expire->generate pipeline against edge-case
+    regressions no enumerated test covers."""
+    env = make_env(episode_limit=60)
+    a = env.n_agents
+    r_max = 2.0 * env.cfg.mec_radius_m * max(env.cfg.mec_num, 1)
+    for seed in range(3):
+        key = jax.random.PRNGKey(100 + seed)
+        st, *_ = env.reset(key)
+        prev_task_num = np.zeros(a, np.int64)
+        for t in range(60):
+            key, ka, ks = jax.random.split(key, 3)
+            avail = env.get_avail_actions(st)
+            actions = jax.random.randint(ka, (a,), 0, env.n_actions)
+            actions = jnp.where(avail[jnp.arange(a), actions] > 0,
+                                actions, 0)
+            st, reward, term, info, obs, gstate, _ = env.step(
+                st, actions, ks)
+
+            assert int(st.time_slot) == t + 1
+            # counters: generated grows monotonically, successes bounded
+            tn = np.asarray(st.task_num, np.int64)
+            assert (tn >= prev_task_num).all()
+            prev_task_num = tn
+            assert (np.asarray(st.task_success) <= tn).all()
+            # queue slots: invalid entries must be zeroed; valid entries
+            # positive-sized with non-negative remaining deadline
+            valid = np.asarray(st.job_valid)
+            data = np.asarray(st.job_data)
+            dl = np.asarray(st.job_deadline)
+            assert (data[~valid] == 0).all() and (dl[~valid] == 0).all()
+            assert (data[valid] > 0).all()
+            assert (dl[valid] >= 0).all()
+            # geometry: finite positions within the deployment extent
+            pos = np.asarray(st.pos)
+            assert np.isfinite(pos).all() and (np.abs(pos) <= r_max).all()
+            # serving MEC ids in range; ack flags in the contract set
+            mi = np.asarray(st.mec_index)
+            assert ((mi >= 0) & (mi < env.cfg.mec_num)).all()
+            assert np.isin(np.asarray(st.last_ack), [-1, 0, 1]).all()
+            # normalizer: counters advance, stats finite, std >= 0
+            assert np.isfinite(np.asarray(st.norm.mean)).all()
+            assert (np.asarray(st.norm.std) >= 0).all()
+            # outputs finite
+            assert np.isfinite(float(reward))
+            assert np.isfinite(np.asarray(obs)).all()
+            assert np.isfinite(np.asarray(gstate)).all()
