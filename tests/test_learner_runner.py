@@ -282,3 +282,27 @@ def test_remat_is_exact(setup):
     np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4), g0, g1)
+
+
+def test_remat_noisy_path_gradients_flow(noisy_setup):
+    """remat wraps the rng-driven scan bodies too (noisy/dropout unrolls
+    carry per-step keys): gradients must stay finite and sigma params
+    still receive signal."""
+    import dataclasses
+
+    cfg, learner, ls, batch = noisy_setup
+    cfg_r = cfg.replace(model=dataclasses.replace(cfg.model, remat=True))
+    learner_r = QMixLearner.build(cfg_r, learner.mac, {
+        "n_agents": learner.mixer.n_agents,
+        "n_entities": learner.mixer.n_entities,
+        "state_entity_feats": learner.mixer.feat_dim,
+        "obs_entity_feats": learner.mixer.feat_dim,
+        "obs_shape": learner.obs_dim, "state_shape": learner.state_dim,
+    })
+    w = jnp.ones((cfg.batch_size_run,))
+    grads, _ = jax.grad(learner_r._loss, has_aux=True)(
+        ls.params, ls.target_params, batch, w, jax.random.PRNGKey(9))
+    leaves = jax.tree.leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in leaves)
+    q_grads = grads["agent"]["params"]["q_basic"]
+    assert np.abs(np.asarray(q_grads["w_sigma"])).max() > 0
