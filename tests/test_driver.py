@@ -250,3 +250,16 @@ def test_model_only_restore_rejects_different_model(tmp_path):
     exp_big = Experiment.build(cfg_big)
     with pytest.raises(ValueError, match="different MODEL"):
         load_learner_state(d, exp_big.init_train_state(0))
+
+
+def test_profile_dir_produces_a_trace(tmp_path):
+    """A1 evidence: profile_dir wires a jax.profiler trace window over the
+    hot loop — the trace files must actually land on disk."""
+    trace_dir = str(tmp_path / "trace")
+    cfg = tiny_cfg(tmp_path, t_max=24, profile_dir=trace_dir,
+                   profile_start=0, profile_iterations=2)
+    run(cfg, Logger())
+    produced = []
+    for root, _, files in os.walk(trace_dir):
+        produced.extend(files)
+    assert produced, "no profiler trace files written"
