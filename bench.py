@@ -392,7 +392,7 @@ def bench_hbm(cfg, args) -> int:
     return 0
 
 
-def bench_all(make_cfg, _time, args) -> int:
+def bench_all(make_cfg, _time, _pipe_rate, args) -> int:
     """``--all``: the full single-chip measurement set in ONE process —
     one backend init total, for tunnel-scarce conditions (BASELINE.md
     axon note). Emits one JSON line per measurement, most important
@@ -428,6 +428,10 @@ def bench_all(make_cfg, _time, args) -> int:
             "n_envs": cfg.batch_size_run,
             "episode_steps": cfg.env_args.episode_limit,
         }
+
+        if args.pipeline:
+            rec["pipelined_env_steps_per_sec"] = _pipe_rate(
+                rollout, params, rs, env_steps, args.pipeline)
         if extra:
             rec.update(extra)
         return rec
@@ -541,9 +545,26 @@ def main() -> int:
                          "head_dim 64, 2 -> head_dim 128 = full MXU lanes)")
     ap.add_argument("--tile", type=int, default=16,
                     help="Pallas kernel tile (sequences per grid step)")
+    ap.add_argument("--pipeline", type=int, default=None, metavar="K",
+                    help="also report the steady-state rate over K "
+                         "async-chained rollouts with one terminal sync "
+                         "(amortizes the per-dispatch tunnel round-trip "
+                         "the way the production driver loop does); "
+                         "--all defaults to K=4, pass 0 to disable")
     args = ap.parse_args()
     if args.no_pallas:
         args.acting = "dense"
+    if args.pipeline is not None and args.pipeline < 0:
+        ap.error("--pipeline K must be >= 0")
+    if args.pipeline and (args.hbm or args.train or args.breakdown or (
+            args.config == 5 and not args.all and not args.smoke)):
+        # these modes don't measure the plain rollout dispatch chain;
+        # silently ignoring the flag would misattribute records
+        ap.error("--pipeline applies to rollout measurements only "
+                 "(default line and --all); drop it for "
+                 "--train/--breakdown/--hbm/--config 5")
+    if args.all and args.pipeline is None:
+        args.pipeline = 4
 
     if args.smoke or args.hbm:
         # --hbm is pure shape arithmetic: never touch a (possibly wedged)
@@ -667,6 +688,22 @@ def main() -> int:
         fn_times.sort()
         return fn_times[len(fn_times) // 2]
 
+    def _pipe_rate(rollout, params, rs, env_steps, k):
+        """Steady-state env-steps/s over k async-chained rollouts with ONE
+        terminal sync. Each dispatch consumes the previous runner state, so
+        the device serializes them, but the host enqueues ahead — the
+        per-call tunnel round-trip (~0.66 s, BASELINE.md) overlaps device
+        compute. This is the rate the production driver loop sees (rollout
+        → insert → train never blocks on a host fetch per episode); the
+        blocking median is the per-dispatch latency."""
+        out = None
+        t0 = time.perf_counter()
+        for _ in range(k):
+            rs, b, _ = rollout(params, rs, test_mode=False)
+            out = b.reward[0, 0]
+        _sync(out)
+        return round(env_steps / ((time.perf_counter() - t0) / k), 1)
+
     import contextlib
 
     @contextlib.contextmanager
@@ -697,7 +734,7 @@ def main() -> int:
                 "headline + config-4 train + pallas/dense + breakdown); "
                 "drop --config/--acting/--train/--breakdown")
         with tracing():
-            return bench_all(make_cfg, _time, args)
+            return bench_all(make_cfg, _time, _pipe_rate, args)
 
     if args.config == 5 and not args.smoke:
         # the DP=8 scale point has its own program shape (sharded mesh);
@@ -764,6 +801,14 @@ def main() -> int:
         "episode_steps": steps,
         "acting": args.acting,
     }
+
+    if args.pipeline:
+        rate_pipe = _pipe_rate(rollout, params, rs, env_steps,
+                               args.pipeline)
+        line["pipelined_env_steps_per_sec"] = rate_pipe
+        print(f"# pipelined (k={args.pipeline}): "
+              f"{rate_pipe:.1f} env-steps/s steady-state",
+              file=sys.stderr)
 
     # the north-star metric is BOTH halves ("env-steps/sec/chip + mixer
     # train-steps/sec", BASELINE.json): append the learner measurement to
