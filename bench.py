@@ -24,6 +24,29 @@ import sys
 import time
 
 
+def _sync(x):
+    """Device→host fetch: the only reliable barrier under the axon remote
+    tunnel, where block_until_ready on async futures returns early."""
+    import numpy as np
+    return float(np.asarray(x))
+
+
+def _chain_seconds(step, carry, k):
+    """Seconds per iteration of k async-chained dispatches with ONE
+    terminal sync. Each dispatch consumes the previous carry, so the
+    device serializes them, but the host enqueues ahead — the per-call
+    tunnel round-trip (~0.66 s, BASELINE.md) overlaps device compute.
+    This is the steady-state rate the production driver loop sees (it
+    never blocks on a host fetch per episode); a blocking median is the
+    per-dispatch latency."""
+    out = None
+    t0 = time.perf_counter()
+    for _ in range(k):
+        carry, out = step(carry)
+    _sync(out)
+    return (time.perf_counter() - t0) / k
+
+
 def breakdown(cfg, exp, ts, _time, args) -> int:
     """Attribute the rollout slot time (stderr table + one JSON line)."""
     import dataclasses
@@ -127,7 +150,8 @@ def breakdown(cfg, exp, ts, _time, args) -> int:
     return 0
 
 
-def _train_numbers(cfg, _time, train_bs: int | None = None) -> dict:
+def _train_numbers(cfg, _time, train_bs: int | None = None,
+                   pipeline_k: int = 0) -> dict:
     """Learner-side throughput — the second half of the north-star metric
     (BASELINE.json: "env-steps/sec/chip + mixer train-steps/sec").
 
@@ -160,20 +184,18 @@ def _train_numbers(cfg, _time, train_bs: int | None = None) -> dict:
                     episode=jnp.asarray(b, jnp.int32))
     key = jax.random.PRNGKey(7)
 
-    def one_train():
-        _, info = train_iter(ts, key, jnp.asarray(1000))
-        return info["loss"]
+    def train_step(ts_):
+        ts2, info = train_iter(ts_, key, jnp.asarray(1000))
+        return ts2, info["loss"]
 
-    dt_train = _time(one_train)
-
-    def one_interleaved():
-        rs2, batch2, _ = rollout(ts.learner.params["agent"], ts.runner,
+    def interleaved_step(ts_):
+        rs2, batch2, _ = rollout(ts_.learner.params["agent"], ts_.runner,
                                  test_mode=False)
-        ts2 = ts.replace(runner=rs2, buffer=insert(ts.buffer, batch2))
-        _, info = train_iter(ts2, key, jnp.asarray(1000))
-        return info["loss"]
+        ts2 = ts_.replace(runner=rs2, buffer=insert(ts_.buffer, batch2))
+        return train_step(ts2)
 
-    dt_full = _time(one_interleaved)
+    dt_train = _time(lambda: train_step(ts)[1])
+    dt_full = _time(lambda: interleaved_step(ts)[1])
 
     env_steps = b * t_len
     print(f"# train_iter ({bs} episodes x {t_len + 1} slots, PER on): "
@@ -182,11 +204,18 @@ def _train_numbers(cfg, _time, train_bs: int | None = None) -> dict:
     print(f"# interleaved rollout+insert+train: {dt_full * 1e3:.1f} ms -> "
           f"{env_steps / dt_full:,.0f} env-steps/s incl. training",
           file=sys.stderr)
-    return {
+    out = {
         "train_steps_per_sec": round(1.0 / dt_train, 2),
         "interleaved_env_steps_per_sec": round(env_steps / dt_full, 1),
         "train_batch_episodes": bs,
     }
+
+    if pipeline_k:
+        out["pipelined_train_steps_per_sec"] = round(
+            1.0 / _chain_seconds(train_step, ts, pipeline_k), 2)
+        out["pipelined_interleaved_env_steps_per_sec"] = round(
+            env_steps / _chain_seconds(interleaved_step, ts, pipeline_k), 1)
+    return out
 
 
 def bench_dp(cfg, _time, args) -> int:
@@ -305,16 +334,16 @@ def bench_dp(cfg, _time, args) -> int:
 
 def bench_train(cfg, _time, args) -> int:
     """``--train``: the learner measurement alone, as the headline line."""
-    nums = _train_numbers(cfg, _time, train_bs=4 if args.smoke else 32)
-    print(json.dumps({
+    nums = _train_numbers(cfg, _time, train_bs=4 if args.smoke else 32,
+                          pipeline_k=args.pipeline or 0)
+    rec = {
         "metric": "train_steps_per_sec",
-        "value": nums["train_steps_per_sec"],
+        "value": nums.pop("train_steps_per_sec"),
         "unit": "train-steps/s/chip",
-        "interleaved_env_steps_per_sec":
-            nums["interleaved_env_steps_per_sec"],
-        "train_batch_episodes": nums["train_batch_episodes"],
         "vs_baseline": None,
-    }))
+    }
+    rec.update(nums)
+    print(json.dumps(rec))
     return 0
 
 
@@ -443,7 +472,8 @@ def bench_all(make_cfg, _time, _pipe_rate, args) -> int:
     cfg3 = make_cfg("qslice", 3)
     rec = rollout_rate(cfg3, "entity/qslice", {"config": cid(3)})
     try:
-        rec.update(_train_numbers(cfg3, _time))
+        rec.update(_train_numbers(cfg3, _time,
+                                  pipeline_k=args.pipeline or 0))
     except Exception as e:                  # pragma: no cover - defensive
         print(f"# train half failed: {e!r}", file=sys.stderr)
     emit(rec)
@@ -452,13 +482,13 @@ def bench_all(make_cfg, _time, _pipe_rate, args) -> int:
     # 2. config 4 train scale (PER + 4096 envs interleave)
     try:
         cfg4 = make_cfg("qslice", 4)
-        nums = _train_numbers(cfg4, _time)
-        emit({"metric": "train_steps_per_sec",
-              "value": nums["train_steps_per_sec"],
-              "unit": "train-steps/s/chip", "vs_baseline": None,
-              "config": cid(4),
-              "interleaved_env_steps_per_sec":
-                  nums["interleaved_env_steps_per_sec"]})
+        nums = _train_numbers(cfg4, _time, pipeline_k=args.pipeline or 0)
+        rec4 = {"metric": "train_steps_per_sec",
+                "value": nums.pop("train_steps_per_sec"),
+                "unit": "train-steps/s/chip", "vs_baseline": None,
+                "config": cid(4)}
+        rec4.update(nums)
+        emit(rec4)
     except Exception as e:                  # pragma: no cover - defensive
         print(f"# config-4 train failed: {e!r}", file=sys.stderr)
     gc.collect()
@@ -556,13 +586,13 @@ def main() -> int:
         args.acting = "dense"
     if args.pipeline is not None and args.pipeline < 0:
         ap.error("--pipeline K must be >= 0")
-    if args.pipeline and (args.hbm or args.train or args.breakdown or (
+    if args.pipeline and (args.hbm or args.breakdown or (
             args.config == 5 and not args.all and not args.smoke)):
-        # these modes don't measure the plain rollout dispatch chain;
-        # silently ignoring the flag would misattribute records
-        ap.error("--pipeline applies to rollout measurements only "
-                 "(default line and --all); drop it for "
-                 "--train/--breakdown/--hbm/--config 5")
+        # these modes don't measure a chainable dispatch loop; silently
+        # ignoring the flag would misattribute records
+        ap.error("--pipeline applies to the rollout/train dispatch "
+                 "chains (default line, --train, --all); drop it for "
+                 "--breakdown/--hbm/--config 5")
     if args.all and args.pipeline is None:
         args.pipeline = 4
 
@@ -670,13 +700,6 @@ def main() -> int:
         n_envs = cfg.batch_size_run
         steps = cfg.env_args.episode_limit
 
-    import numpy as np
-
-    def _sync(x):
-        # device→host fetch: the only reliable barrier under the axon remote
-        # tunnel, where block_until_ready on async futures returns early
-        return float(np.asarray(x))
-
     def _time(fn, iters=args.iters):
         """median seconds of fn() (fn must return an array to sync on)."""
         fn_times = []
@@ -689,20 +712,12 @@ def main() -> int:
         return fn_times[len(fn_times) // 2]
 
     def _pipe_rate(rollout, params, rs, env_steps, k):
-        """Steady-state env-steps/s over k async-chained rollouts with ONE
-        terminal sync. Each dispatch consumes the previous runner state, so
-        the device serializes them, but the host enqueues ahead — the
-        per-call tunnel round-trip (~0.66 s, BASELINE.md) overlaps device
-        compute. This is the rate the production driver loop sees (rollout
-        → insert → train never blocks on a host fetch per episode); the
-        blocking median is the per-dispatch latency."""
-        out = None
-        t0 = time.perf_counter()
-        for _ in range(k):
-            rs, b, _ = rollout(params, rs, test_mode=False)
-            out = b.reward[0, 0]
-        _sync(out)
-        return round(env_steps / ((time.perf_counter() - t0) / k), 1)
+        """Steady-state env-steps/s over k async-chained rollouts
+        (see _chain_seconds)."""
+        def step(rs_):
+            rs2, b, _ = rollout(params, rs_, test_mode=False)
+            return rs2, b.reward[0, 0]
+        return round(env_steps / _chain_seconds(step, rs, k), 1)
 
     import contextlib
 
@@ -819,7 +834,8 @@ def main() -> int:
         print(f"# headline: {json.dumps(line)}", file=sys.stderr, flush=True)
         del ts, rs, batch, stats, rollout, params, exp
         try:
-            line.update(_train_numbers(cfg, _time))
+            line.update(_train_numbers(cfg, _time,
+                                       pipeline_k=args.pipeline or 0))
         except Exception as e:      # pragma: no cover - defensive
             print(f"# train bench failed: {e!r}", file=sys.stderr)
 

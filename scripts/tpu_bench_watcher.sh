@@ -21,9 +21,21 @@ while :; do
   echo "[watcher] attempt $N at $(date -u +%FT%TZ)" >> "$OUT/watcher.log"
   python bench.py --all > "$LOG" 2>&1
   RC=$?
-  if grep -q '"value": *[0-9]' "$LOG"; then
+  # full success only: rc==0 (bench_all ran every leg; per-leg failures
+  # are caught internally and noted on stderr) AND a real numeric value
+  # landed. A crash after a partial emit (rc!=0) must keep retrying.
+  if [ "$RC" -eq 0 ] && grep -q '"value": *[0-9]' "$LOG"; then
     echo "[watcher] SUCCESS on attempt $N (rc=$RC)" >> "$OUT/watcher.log"
     cp "$LOG" "$OUT/SUCCESS.log"
+    break
+  fi
+  # a deterministic post-headline hard crash (rc!=0, but the headline
+  # value landed — bench_all emits most-important-first for exactly this
+  # case) must not loop forever: accept the partial set after 3 tries
+  if [ "$N" -ge 3 ] && grep -q '"value": *[0-9]' "$LOG"; then
+    echo "[watcher] PARTIAL accepted on attempt $N (rc=$RC)" \
+      >> "$OUT/watcher.log"
+    cp "$LOG" "$OUT/PARTIAL.log"
     break
   fi
   echo "[watcher] attempt $N failed (rc=$RC); cooling down ${COOLDOWN}s" \

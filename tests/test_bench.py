@@ -44,6 +44,19 @@ def test_acting_selector_reported(acting):
     assert rec["value"] > 0
 
 
+def test_pipeline_flag_adds_steady_state_rate():
+    rec = run_bench("--pipeline", "2")
+    assert rec["pipelined_env_steps_per_sec"] > 0
+    # the blocking median stays the headline value
+    assert rec["metric"] == "env_steps_per_sec" and rec["value"] > 0
+
+
+def test_pipeline_train_steady_state():
+    rec = run_bench("--train", "--pipeline", "2")
+    assert rec["pipelined_train_steps_per_sec"] > 0
+    assert rec["pipelined_interleaved_env_steps_per_sec"] > 0
+
+
 def test_committed_config_presets_load():
     """The configs/ presets (BASELINE measurement points as config files —
     the reference's sacred-config workflow, M14) must stay loadable and
