@@ -23,11 +23,12 @@ import json
 import sys
 import time
 
+import numpy as np
+
 
 def _sync(x):
     """Device→host fetch: the only reliable barrier under the axon remote
     tunnel, where block_until_ready on async futures returns early."""
-    import numpy as np
     return float(np.asarray(x))
 
 
@@ -335,7 +336,7 @@ def bench_dp(cfg, _time, args) -> int:
 def bench_train(cfg, _time, args) -> int:
     """``--train``: the learner measurement alone, as the headline line."""
     nums = _train_numbers(cfg, _time, train_bs=4 if args.smoke else 32,
-                          pipeline_k=args.pipeline or 0)
+                          pipeline_k=args.pipeline)
     rec = {
         "metric": "train_steps_per_sec",
         "value": nums.pop("train_steps_per_sec"),
@@ -473,7 +474,7 @@ def bench_all(make_cfg, _time, _pipe_rate, args) -> int:
     rec = rollout_rate(cfg3, "entity/qslice", {"config": cid(3)})
     try:
         rec.update(_train_numbers(cfg3, _time,
-                                  pipeline_k=args.pipeline or 0))
+                                  pipeline_k=args.pipeline))
     except Exception as e:                  # pragma: no cover - defensive
         print(f"# train half failed: {e!r}", file=sys.stderr)
     emit(rec)
@@ -482,7 +483,7 @@ def bench_all(make_cfg, _time, _pipe_rate, args) -> int:
     # 2. config 4 train scale (PER + 4096 envs interleave)
     try:
         cfg4 = make_cfg("qslice", 4)
-        nums = _train_numbers(cfg4, _time, pipeline_k=args.pipeline or 0)
+        nums = _train_numbers(cfg4, _time, pipeline_k=args.pipeline)
         rec4 = {"metric": "train_steps_per_sec",
                 "value": nums.pop("train_steps_per_sec"),
                 "unit": "train-steps/s/chip", "vs_baseline": None,
@@ -580,7 +581,8 @@ def main() -> int:
                          "async-chained rollouts with one terminal sync "
                          "(amortizes the per-dispatch tunnel round-trip "
                          "the way the production driver loop does); "
-                         "--all defaults to K=4, pass 0 to disable")
+                         "defaults to K=4 on full-scale runs, pass 0 "
+                         "to disable")
     args = ap.parse_args()
     if args.no_pallas:
         args.acting = "dense"
@@ -593,8 +595,14 @@ def main() -> int:
         ap.error("--pipeline applies to the rollout/train dispatch "
                  "chains (default line, --train, --all); drop it for "
                  "--breakdown/--hbm/--config 5")
-    if args.all and args.pipeline is None:
-        args.pipeline = 4
+    if args.pipeline is None:
+        # default ON (K=4) wherever a dispatch chain is measured, so the
+        # driver's plain `python bench.py` artifact carries the
+        # steady-state rate; --pipeline 0 disables. Smoke stays off (the
+        # CPU contract tests pin the minimal schema).
+        measures_chain = not (args.smoke or args.hbm or args.breakdown
+                              or (args.config == 5 and not args.all))
+        args.pipeline = 4 if measures_chain else 0
 
     if args.smoke or args.hbm:
         # --hbm is pure shape arithmetic: never touch a (possibly wedged)
@@ -835,7 +843,7 @@ def main() -> int:
         del ts, rs, batch, stats, rollout, params, exp
         try:
             line.update(_train_numbers(cfg, _time,
-                                       pipeline_k=args.pipeline or 0))
+                                       pipeline_k=args.pipeline))
         except Exception as e:      # pragma: no cover - defensive
             print(f"# train bench failed: {e!r}", file=sys.stderr)
 

@@ -11,6 +11,9 @@
 #
 # Usage: nohup scripts/tpu_bench_watcher.sh [outdir] &
 set -u
+cd "$(dirname "$0")/.."           # bench.py lives at the repo root
+PYTHON=${PYTHON:-python}
+command -v "$PYTHON" > /dev/null || PYTHON=python3
 OUT=${1:-/tmp/tpu_bench}
 mkdir -p "$OUT"
 COOLDOWN=${T2OMCA_WATCHER_COOLDOWN:-600}
@@ -19,7 +22,7 @@ while :; do
   N=$((N + 1))
   LOG="$OUT/attempt_$N.log"
   echo "[watcher] attempt $N at $(date -u +%FT%TZ)" >> "$OUT/watcher.log"
-  python bench.py --all > "$LOG" 2>&1
+  "$PYTHON" bench.py --all > "$LOG" 2>&1
   RC=$?
   # full success only: rc==0 (bench_all ran every leg; per-leg failures
   # are caught internally and noted on stderr) AND a real numeric value
