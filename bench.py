@@ -399,7 +399,11 @@ def bench_hbm(cfg, args) -> int:
 
     rows = {
         "replay_ring": ring,
-        "rollout_episode_batch": rollout_batch,
+        # ×3: the async driver loop bounds dispatch run-ahead at 2, so up
+        # to 3 episode batches can be live at once (run.run_sequential) —
+        # an upper bound; cadence barriers (B·T ≥ log intervals at the
+        # large configs) usually keep fewer in flight
+        "rollout_episode_batch": 3 * rollout_batch,
         "train_episode_batch": train_batch,
         "learner_scan_residuals": residuals,
     }

@@ -152,6 +152,11 @@ class TrainConfig:
     profile_dir: str = ""                 # jax.profiler trace output ("" = off)
     profile_start: int = 0                # t_env at which to start the trace
     profile_iterations: int = 3           # driver iterations to capture
+    # block after each driver stage so StageTimer attributes real device
+    # time instead of dispatch-enqueue time; costs one host round-trip per
+    # stage (~0.66 s each under the axon tunnel), so off in production —
+    # the async loop then only syncs at log/test/save cadences
+    profile_stages: bool = False
 
     # component selection (registries, reference §5.6; agent/mixer families
     # follow the parent PyMARL lineage's registry pattern — the released

@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
+from collections import deque
 from typing import Optional, Tuple
 
 import jax
@@ -275,6 +276,27 @@ def run_sequential(exp: Experiment, logger: Logger,
     timer = StageTimer()
     tracer = TraceWindow(cfg.profile_dir, cfg.profile_start,
                          cfg.profile_iterations)
+    # per-stage barriers for honest attribution; tracing implies them
+    # (an un-synced trace window would capture dispatch, not execution)
+    sync_stages = cfg.profile_stages or bool(cfg.profile_dir)
+
+    # ---- async dispatch ------------------------------------------------
+    # Every control scalar of this loop evolves deterministically: the
+    # rollout scan always runs episode_limit slots (termination is
+    # time-limit-only, envs/mec_offload.py step), so t_env advances by
+    # exactly B·T per train rollout; the episode counter by B; the replay
+    # ring fill by min(+B, capacity). Tracking them host-side removes
+    # every blocking device→host fetch from the loop body — under the
+    # axon remote tunnel one fetch is a ~0.66 s round-trip (BASELINE.md),
+    # which would otherwise serialize the driver on the slowest link.
+    # The loop then only blocks at its natural cadences (stat flush, log,
+    # test, checkpoint), letting the host enqueue ahead of the device.
+    steps_per_rollout = cfg.batch_size_run * cfg.env_args.episode_limit
+    episode = int(jax.device_get(ts.episode))          # restored on resume
+    buffer_filled = (0 if exp.host_buffer else
+                     int(jax.device_get(ts.buffer.episodes_in_buffer)))
+    buffer_capacity = 0 if exp.host_buffer else exp.buffer.capacity
+    inflight = deque()              # rollout outputs not yet waited on
 
     while t_env <= cfg.t_max:
         tracer.maybe_start(t_env)
@@ -285,29 +307,44 @@ def run_sequential(exp: Experiment, logger: Logger,
             ts = ts.replace(runner=rs,
                             buffer=insert(ts.buffer, batch),
                             episode=ts.episode + cfg.batch_size_run)
-            t_env = int(jax.device_get(rs.t_env))
+            if sync_stages:
+                jax.block_until_ready(rs.t_env)
+        t_env += steps_per_rollout
+        episode += cfg.batch_size_run
+        buffer_filled = min(buffer_filled + cfg.batch_size_run,
+                            buffer_capacity)
         train_acc.push(stats)
-        # train-stat cadence: runner_log_interval, epsilon alongside
-        # (reference parallel_runner.py:215-219)
-        if t_env - last_runner_log_t >= cfg.runner_log_interval:
-            train_acc.flush(logger, t_env)
-            logger.log_stat("epsilon", train_acc.epsilon, t_env)
-            last_runner_log_t = t_env
+        # bound the dispatch run-ahead: block on the rollout from two
+        # iterations back (TPU executes in dispatch order, so this caps
+        # live episode batches at ~3 while still double-buffering
+        # host↔device)
+        inflight.append(stats.epsilon)
+        if len(inflight) > 2:
+            jax.block_until_ready(inflight.popleft())
 
         # ---------------- train gate (reference :220-238) ------------------
         if exp.host_buffer:
             can = exp.buffer.can_sample(cfg.batch_size)
         else:
-            can = bool(jax.device_get(
-                exp.buffer.can_sample(ts.buffer, cfg.batch_size)))
-        episode = int(jax.device_get(ts.episode))
+            can = buffer_filled >= cfg.batch_size
         if can and episode >= cfg.accumulated_episodes:
             key, k_sample = jax.random.split(key)
             with timer.stage("train"):
                 ts, info = train_iter(ts, k_sample, jnp.asarray(t_env))
-                jax.block_until_ready(info["loss"])
+                if sync_stages:
+                    jax.block_until_ready(info["loss"])
             train_infos.append(info)
         tracer.tick(logger)
+
+        # train-stat cadence: runner_log_interval, epsilon alongside
+        # (reference parallel_runner.py:215-219). Deliberately after the
+        # train dispatch: at configs where B·T ≥ the interval this flush
+        # fires every iteration, and its blocking stat fetch then overlaps
+        # the already-enqueued train step instead of serializing it.
+        if t_env - last_runner_log_t >= cfg.runner_log_interval:
+            train_acc.flush(logger, t_env)
+            logger.log_stat("epsilon", train_acc.epsilon, t_env)
+            last_runner_log_t = t_env
 
         # ---------------- test cadence (reference :240-256) ----------------
         if (t_env - last_test_t) / cfg.test_interval >= 1.0:

@@ -34,32 +34,60 @@ TERMINAL_INFO_KEYS = (
 
 
 class StatsAccumulator:
-    """Accumulates RolloutStats across rollouts; flush = reference ``_log``."""
+    """Accumulates RolloutStats across rollouts; flush = reference ``_log``.
+
+    ``push`` only *references* the device arrays (shape-derived episode
+    count, no transfer); the device→host fetch happens once per ``flush``.
+    Under the remote-tunnel backend a fetch is a blocking ~0.66 s round
+    trip (BASELINE.md), so per-rollout fetching would serialize the driver
+    loop on the slowest link; deferring it lets dispatch run ahead between
+    log cadences. Aggregation semantics are unchanged."""
 
     def __init__(self):
-        self.stats = defaultdict(float)
         self.n_episodes = 0
-        self.returns: List[float] = []
-        self.epsilon = 0.0
+        self._pending = []          # un-fetched RolloutStats device refs
+        self._eps_ref = None        # epsilon pushed since the last fetch
+        self._eps_val = 0.0         # cached host value
 
     def push(self, rollout_stats) -> None:
-        s = jax.device_get(rollout_stats)
-        ret = np.atleast_1d(np.asarray(s.episode_return))
-        self.returns.extend(float(x) for x in ret)
-        self.n_episodes += len(ret)
-        for k in TERMINAL_INFO_KEYS:
-            self.stats[k] += float(np.sum(getattr(s, k)))
-        self.epsilon = float(np.mean(np.asarray(s.epsilon)))
+        self._pending.append(rollout_stats)
+        self._eps_ref = rollout_stats.epsilon
+        # episode count is static shape info — reading it syncs nothing
+        self.n_episodes += int(
+            np.prod(rollout_stats.episode_return.shape) or 1)
+
+    @property
+    def epsilon(self) -> float:
+        """Exploration rate of the most recent rollout (reference logs it
+        alongside each train-stat flush, ``parallel_runner.py:217-218``).
+        ``flush`` refreshes the cached value inside its own fetch; a
+        standalone read only syncs when pushes happened since."""
+        if self._eps_ref is not None:
+            self._eps_val = float(np.mean(np.asarray(
+                jax.device_get(self._eps_ref))))
+            self._eps_ref = None
+        return self._eps_val
 
     def flush(self, logger, t_env: int, prefix: str = "") -> None:
         """Log ``return_mean`` + every ``<k>_mean`` and clear
         (``/root/reference/parallel_runner.py:222-231``)."""
-        if self.returns:
+        fetched = jax.device_get(self._pending)   # ONE host round-trip
+        returns: List[float] = []
+        stats = defaultdict(float)
+        for s in fetched:
+            ret = np.atleast_1d(np.asarray(s.episode_return))
+            returns.extend(float(x) for x in ret)
+            for k in TERMINAL_INFO_KEYS:
+                stats[k] += float(np.sum(getattr(s, k)))
+        if fetched:
+            # the last pending entry owns the epsilon ref — same fetch
+            self._eps_val = float(np.mean(np.asarray(fetched[-1].epsilon)))
+            self._eps_ref = None
+        if returns:
             logger.log_stat(prefix + "return_mean",
-                            float(np.mean(self.returns)), t_env)
+                            float(np.mean(returns)), t_env)
         n = max(self.n_episodes, 1)
-        for k, v in self.stats.items():
+        for k, v in stats.items():
             logger.log_stat(prefix + k + "_mean", v / n, t_env)
-        self.stats.clear()
-        self.returns.clear()
+        self._pending.clear()
         self.n_episodes = 0
