@@ -466,6 +466,10 @@ def bench_all(make_cfg, _time, _pipe_rate, args) -> int:
         if args.pipeline:
             rec["pipelined_env_steps_per_sec"] = _pipe_rate(
                 rollout, params, rs, env_steps, args.pipeline)
+        if jax.config.jax_default_prng_impl != "threefry2x32":
+            # read back the live impl, not the flag echo — a broken
+            # switch must not be misattributed as an rbg measurement
+            rec["prng"] = jax.config.jax_default_prng_impl
         if extra:
             rec.update(extra)
         return rec
@@ -580,6 +584,11 @@ def main() -> int:
                          "head_dim 64, 2 -> head_dim 128 = full MXU lanes)")
     ap.add_argument("--tile", type=int, default=16,
                     help="Pallas kernel tile (sequences per grid step)")
+    ap.add_argument("--prng", choices=("threefry", "rbg", "unsafe_rbg"),
+                    default="threefry",
+                    help="PRNG impl for all keys: rbg = the TPU hardware "
+                         "bit generator (cheaper for the rollout's many "
+                         "small draws; different stream than threefry)")
     ap.add_argument("--pipeline", type=int, default=None, metavar="K",
                     help="also report the steady-state rate over K "
                          "async-chained rollouts with one terminal sync "
@@ -668,6 +677,7 @@ def main() -> int:
         steps = args.steps or 8
         cfg = sanity_check(TrainConfig(
             batch_size_run=n_envs,
+            prng_impl=args.prng,
             env_args=EnvConfig(agv_num=4, mec_num=2, num_channels=2,
                                episode_limit=steps),
             model=ModelConfig(emb=16, heads=2, depth=1, mixer_emb=16,
@@ -687,6 +697,7 @@ def main() -> int:
             c = _CONFIGS[config_id]
             return sanity_check(TrainConfig(
                 batch_size_run=args.envs or c["envs"],
+                prng_impl=args.prng,
                 env_args=EnvConfig(agv_num=c["agv"], mec_num=c["mec"],
                                    num_channels=c["ch"],
                                    episode_limit=args.steps or 32,
@@ -828,6 +839,9 @@ def main() -> int:
         "episode_steps": steps,
         "acting": args.acting,
     }
+    if jax.config.jax_default_prng_impl != "threefry2x32":
+        # live impl, not the flag echo (see rollout_rate in bench_all)
+        line["prng"] = jax.config.jax_default_prng_impl
 
     if args.pipeline:
         rate_pipe = _pipe_rate(rollout, params, rs, env_steps,

@@ -135,6 +135,14 @@ class TrainConfig:
     # 0 = single-device programs. Replaces the reference's single-device
     # select (/root/reference/per_run.py:26).
     dp_devices: int = 0
+    # PRNG implementation for every key in the run: "threefry" (JAX
+    # default — counter-based, reproducible across backends; all parity
+    # and learning-evidence configs use it) or "rbg" (XLA
+    # RngBitGenerator — the TPU hardware generator, far cheaper for the
+    # rollout's many small draws: teleports, job generation, exploration
+    # noise; streams differ from threefry, so trajectories are not
+    # bit-comparable across the two)
+    prng_impl: str = "threefry"
     evaluate: bool = False
     benchmark_mode: bool = False          # export per-episode CSV during eval
     checkpoint_path: str = ""
@@ -209,6 +217,9 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
         tn = cfg.batch_size_run
     else:
         tn = (tn // cfg.batch_size_run) * cfg.batch_size_run
+    if cfg.prng_impl not in ("threefry", "rbg", "unsafe_rbg"):
+        raise ValueError(f"prng_impl must be threefry/rbg/unsafe_rbg, "
+                         f"got {cfg.prng_impl!r}")
     if cfg.model.standard_heads:
         if cfg.model.emb % cfg.model.heads or cfg.model.mixer_emb % cfg.model.mixer_heads:
             raise ValueError(

@@ -68,6 +68,17 @@ class Experiment:
     @classmethod
     def build(cls, cfg: TrainConfig) -> "Experiment":
         cfg = sanity_check(cfg)
+        # process-global by necessity: raw PRNGKey arrays carry no impl
+        # tag, so every split/draw in the jitted programs resolves the
+        # impl from this config. Set unconditionally — a prior build in
+        # the same process may have switched it. "rbg" = XLA
+        # RngBitGenerator, the TPU hardware generator — much cheaper than
+        # threefry for the rollout's many small draws. Key shapes differ
+        # (4 vs 2 uint32), so checkpoints are impl-specific
+        # (shape-validated restore names the mismatch).
+        jax.config.update("jax_default_prng_impl",
+                          {"threefry": "threefry2x32"}.get(cfg.prng_impl,
+                                                           cfg.prng_impl))
         env = make_env(cfg.env_args)
         env_info = env.get_env_info()
         mac = MAC_REGISTRY[cfg.mac].build(cfg, env_info)

@@ -44,6 +44,16 @@ def test_acting_selector_reported(acting):
     assert rec["value"] > 0
 
 
+def test_prng_rbg_end_to_end():
+    """--prng rbg routes every key through the XLA RngBitGenerator (the
+    TPU-hardware path; subprocess keeps the process-global impl switch
+    out of this pytest process). The record must carry the non-default
+    impl so a chip measurement can't be misattributed to threefry."""
+    rec = run_bench("--prng", "rbg")
+    assert rec["value"] > 0
+    assert rec["prng"] == "rbg"
+
+
 def test_pipeline_flag_adds_steady_state_rate():
     rec = run_bench("--pipeline", "2")
     assert rec["pipelined_env_steps_per_sec"] > 0

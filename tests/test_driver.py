@@ -147,6 +147,11 @@ def test_dp_devices_drives_training_from_config_alone(tmp_path):
     assert int(jax.device_get(ts2.runner.t_env)) > step
 
 
+def test_sanity_rejects_unknown_prng_impl():
+    with pytest.raises(ValueError, match="prng_impl"):
+        sanity_check(TrainConfig(prng_impl="philox"))
+
+
 def test_dp_devices_sanity_rejects_host_buffer():
     with pytest.raises(ValueError, match="buffer_cpu_only"):
         sanity_check(TrainConfig(
