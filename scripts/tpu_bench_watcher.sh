@@ -4,7 +4,8 @@
 # The axon tunnel wedges if a client is killed mid-compile or if two
 # processes race for the device claim (BASELINE.md axon note). So:
 #   - exactly ONE process touches the TPU at a time (this loop, serial);
-#   - never kill the bench; its own probe bound (900 s default) handles a
+#   - never kill the bench; its own probe bound (300 s here, see the
+#     T2OMCA_BACKEND_PROBE_TIMEOUT export below) handles a
 #     wedged init by emitting a parseable error record and exiting;
 #   - on failure, cool down before the next attempt so a stale remote
 #     claim can expire.
@@ -17,7 +18,13 @@ command -v "$PYTHON" > /dev/null || PYTHON=python3
 OUT=${1:-/tmp/tpu_bench}
 mkdir -p "$OUT"
 COOLDOWN=${T2OMCA_WATCHER_COOLDOWN:-600}
+MAX_COOLDOWN=${T2OMCA_WATCHER_MAX_COOLDOWN:-3600}
+# short probe bound: a healthy init is seconds; a wedged one never
+# completes, and the hanging init itself holds a half-open claim that
+# may prolong the wedge — touch the tunnel briefly, then back off
+export T2OMCA_BACKEND_PROBE_TIMEOUT=${T2OMCA_BACKEND_PROBE_TIMEOUT:-300}
 N=0
+SLEEP=$COOLDOWN
 while :; do
   N=$((N + 1))
   LOG="$OUT/attempt_$N.log"
@@ -41,7 +48,14 @@ while :; do
     cp "$LOG" "$OUT/PARTIAL.log"
     break
   fi
-  echo "[watcher] attempt $N failed (rc=$RC); cooling down ${COOLDOWN}s" \
+  # exponential backoff on wedged-probe failures (longer quiet periods
+  # give the remote claim time to clear); reset on any other failure
+  if grep -q "probe bound" "$LOG"; then
+    SLEEP=$((SLEEP * 2)); [ "$SLEEP" -gt "$MAX_COOLDOWN" ] && SLEEP=$MAX_COOLDOWN
+  else
+    SLEEP=$COOLDOWN
+  fi
+  echo "[watcher] attempt $N failed (rc=$RC); cooling down ${SLEEP}s" \
     >> "$OUT/watcher.log"
-  sleep "$COOLDOWN"
+  sleep "$SLEEP"
 done
