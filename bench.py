@@ -40,7 +40,11 @@ def _chain_seconds(step, carry, k):
     This is the steady-state rate the production driver loop sees (it
     never blocks on a host fetch per episode); a blocking median is the
     per-dispatch latency."""
-    out = None
+    # one warm chained step first: the chained carry can have a different
+    # layout/sharding than the caller's warm-path input (GSPMD output
+    # placement), and that one-time recompile must not be timed
+    carry, out = step(carry)
+    _sync(out)
     t0 = time.perf_counter()
     for _ in range(k):
         carry, out = step(carry)
@@ -280,13 +284,26 @@ def bench_dp(cfg, _time, args) -> int:
           f"env-steps ({cfg.batch_size_run} envs sharded over "
           f"{n_dev} devices)", file=sys.stderr)
 
+    rate_pipe = None
+    if args.pipeline:
+        def roll_step(rs_):
+            rs2, b, _ = rollout(params, rs_, test_mode=False)
+            return rs2, b.reward[0, 0]
+        rate_pipe = round(
+            env_steps / _chain_seconds(roll_step, ts.runner, args.pipeline),
+            1)
+
     # ---- train half: fill the ring with a slice of real episodes (the
     # rollout batch can exceed ring capacity at config-5 scale), keeping
     # the episode axis sharded, then time the full DP train iteration
     fill = jax.tree.map(lambda x: x[:ring], batch)
     fill = jax.device_put(fill, NamedSharding(mesh, P("data")))
     ts = ts.replace(runner=rs, buffer=insert(ts.buffer, fill),
-                    episode=jnp.asarray(ring, jnp.int32))
+                    # mesh-replicated, matching dp.shard — a single-device
+                    # scalar here would give the chained train_iter a
+                    # different input aval and force a second compile
+                    episode=jax.device_put(jnp.asarray(ring, jnp.int32),
+                                           NamedSharding(mesh, P())))
     key = jax.random.PRNGKey(7)
 
     def one_train():
@@ -294,6 +311,13 @@ def bench_dp(cfg, _time, args) -> int:
         return info["loss"]
 
     dt_train = _time(one_train)
+    train_pipe = None
+    if args.pipeline:
+        def train_step(ts_):
+            ts2_, info = train_iter(ts_, key, jnp.asarray(1000))
+            return ts2_, info["loss"]
+        train_pipe = round(
+            1.0 / _chain_seconds(train_step, ts, args.pipeline), 2)
     ts2, _ = train_iter(ts, key, jnp.asarray(1000))
     leaf = jax.tree.leaves(ts2.learner.params)[0]
     assert leaf.sharding.is_fully_replicated, \
@@ -317,8 +341,11 @@ def bench_dp(cfg, _time, args) -> int:
         "train_steps_per_sec": round(1.0 / dt_train, 2),
         "train_batch_episodes": bs,
     }
+    pipe_keys = {k: v for k, v in (
+        ("pipelined_env_steps_per_sec", rate_pipe),
+        ("pipelined_train_steps_per_sec", train_pipe)) if v is not None}
     if args.train:
-        print(json.dumps({
+        rec = {
             "metric": "train_steps_per_sec",
             "value": round(1.0 / dt_train, 2),
             "unit": f"train-steps/s/{n_dev}-device-mesh",
@@ -327,9 +354,11 @@ def bench_dp(cfg, _time, args) -> int:
             "dp": n_dev,
             "train_batch_episodes": bs,
             "env_steps_per_sec": round(rate, 1),
-        }))
+        }
     else:
-        print(json.dumps(rollout_rec))
+        rec = rollout_rec
+    rec.update(pipe_keys)
+    print(json.dumps(rec))
     return 0
 
 
@@ -601,20 +630,18 @@ def main() -> int:
         args.acting = "dense"
     if args.pipeline is not None and args.pipeline < 0:
         ap.error("--pipeline K must be >= 0")
-    if args.pipeline and (args.hbm or args.breakdown or (
-            args.config == 5 and not args.all and not args.smoke)):
+    if args.pipeline and (args.hbm or args.breakdown):
         # these modes don't measure a chainable dispatch loop; silently
         # ignoring the flag would misattribute records
         ap.error("--pipeline applies to the rollout/train dispatch "
-                 "chains (default line, --train, --all); drop it for "
-                 "--breakdown/--hbm/--config 5")
+                 "chains (default line, --train, --config 5, --all); "
+                 "drop it for --breakdown/--hbm")
     if args.pipeline is None:
         # default ON (K=4) wherever a dispatch chain is measured, so the
         # driver's plain `python bench.py` artifact carries the
         # steady-state rate; --pipeline 0 disables. Smoke stays off (the
         # CPU contract tests pin the minimal schema).
-        measures_chain = not (args.smoke or args.hbm or args.breakdown
-                              or (args.config == 5 and not args.all))
+        measures_chain = not (args.smoke or args.hbm or args.breakdown)
         args.pipeline = 4 if measures_chain else 0
 
     if args.smoke or args.hbm:

@@ -525,7 +525,11 @@ class MultiAgvOffloadingEnv:
         utilization = masked.sum() / (self.cfg.num_channels * self.n_mec)
 
         chosen = masked[state.mec_index, actions]
-        ack = jnp.where(actions == 0, 0, jnp.where(chosen == 1, 1, -1))
+        # explicit int32: a weak-typed ack in the carried state would give
+        # the rollout program weak output avals and force a second compile
+        # when the driver chains the state back in
+        ack = jnp.where(actions == 0, 0,
+                        jnp.where(chosen == 1, 1, -1)).astype(jnp.int32)
         conflict_ratio = (ack == -1).mean()
 
         state = state.replace(

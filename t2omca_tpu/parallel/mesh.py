@@ -112,17 +112,43 @@ class DataParallel:
     # ------------------------------------------------------------------ programs
 
     def jitted_programs(self, donate: bool = False):
-        """The experiment's own three programs with a
-        ``with_sharding_constraint`` injected on every episode batch, so the
-        episode axis stays distributed end-to-end (rollout → insert →
-        sample → train; grads are psum'd by GSPMD since params are
-        replicated and the loss averages over a sharded batch).
+        """The experiment's own three programs with
+        ``with_sharding_constraint`` injected on every chained value:
+        episode batches (episode axis distributed end-to-end: rollout →
+        insert → sample → train; grads are psum'd by GSPMD since params
+        are replicated and the loss averages over a sharded batch) AND
+        the runner/replay/learner states the driver loop feeds back in.
+        Output constraints pin each program's outputs to the exact
+        placement ``shard`` gives its inputs — otherwise GSPMD may pick
+        different output shardings and every later loop iteration would
+        compile and run a second, differently-sharded executable.
 
         ``donate`` has the same contract as
         ``Experiment.jitted_programs(donate=...)``: in-place replay ring and
         train state for drivers that never reuse the pre-call value."""
-        batch_sharding = NamedSharding(self.mesh, P(self.axis))
+        data = NamedSharding(self.mesh, P(self.axis))
+        rep = NamedSharding(self.mesh, P())
+        wsc = jax.lax.with_sharding_constraint
+
+        def constrain_runner(rs):
+            return rs.replace(
+                env_states=jax.tree.map(lambda x: wsc(x, data),
+                                        rs.env_states),
+                key=wsc(rs.key, rep),
+                t_env=wsc(rs.t_env, rep))
+
+        def constrain_buffer(buf):
+            return buf.replace(
+                storage=jax.tree.map(lambda x: wsc(x, data), buf.storage),
+                insert_pos=wsc(buf.insert_pos, rep),
+                episodes_in_buffer=wsc(buf.episodes_in_buffer, rep),
+                priorities=wsc(buf.priorities, rep),
+                max_priority=wsc(buf.max_priority, rep))
+
         return self.exp.jitted_programs(
-            constrain_batch=lambda b: jax.lax.with_sharding_constraint(
-                b, batch_sharding),
+            constrain_batch=lambda b: wsc(b, data),
+            constrain_runner=constrain_runner,
+            constrain_buffer=constrain_buffer,
+            constrain_learner=lambda l: jax.tree.map(
+                lambda x: wsc(x, rep), l),
             donate=donate)

@@ -134,14 +134,21 @@ class Experiment:
 
     # ------------------------------------------------------------------ programs
 
-    def jitted_programs(self, constrain_batch=None, donate: bool = False):
+    def jitted_programs(self, constrain_batch=None, constrain_runner=None,
+                        constrain_buffer=None, constrain_learner=None,
+                        donate: bool = False):
         """→ (rollout, insert, train_iter) jitted programs.
 
-        ``constrain_batch`` is an optional ``EpisodeBatch → EpisodeBatch``
-        hook applied to rollout outputs and training samples — the
-        multi-chip path (``parallel.DataParallel``) injects a
-        ``with_sharding_constraint`` through it so both paths share one
-        train-iteration definition.
+        The ``constrain_*`` hooks are optional identity-shaped functions
+        applied to program outputs — the multi-chip path
+        (``parallel.DataParallel``) injects ``with_sharding_constraint``
+        through them so both paths share one program definition. They
+        cover every value the driver loop CHAINS back in as an input
+        (episode batches, runner state, replay state, learner state):
+        without the output constraints GSPMD is free to choose different
+        output shardings than the canonical input placement, and the
+        second-and-later iterations of the loop would silently compile
+        and run a differently-sharded program.
 
         ``donate=True`` donates the replay ring to ``insert`` and the train
         state to ``train_iter`` — XLA then updates both in place instead of
@@ -152,10 +159,22 @@ class Experiment:
         runner, buffer, learner, cfg = (self.runner, self.buffer,
                                         self.learner, self.cfg)
         constrain = constrain_batch or (lambda b: b)
+        c_runner = constrain_runner or (lambda rs: rs)
+        c_buffer = constrain_buffer or (lambda b: b)
+        c_learner = constrain_learner or (lambda l: l)
+
+        def _strong(tree):
+            """Drop weak_type from every chained output: the driver feeds
+            these back as inputs, and a weak-typed leaf (e.g. from a
+            Python-scalar jnp.where branch) makes the output aval differ
+            from the strong input aval — forcing a silent second compile
+            of the whole program on loop iteration 2. astype(same-dtype)
+            is a no-op in XLA but strips the weak flag."""
+            return jax.tree.map(lambda x: x.astype(x.dtype), tree)
 
         def _rollout(params, rs, test_mode):
             rs2, batch, stats = runner.run(params, rs, test_mode=test_mode)
-            return rs2, constrain(batch), stats
+            return _strong(c_runner(rs2)), constrain(batch), stats
 
         rollout = jax.jit(_rollout, static_argnames="test_mode")
 
@@ -182,8 +201,11 @@ class Experiment:
 
             return rollout, insert, train_iter_host
 
-        insert = jax.jit(buffer.insert_episode_batch,
-                         donate_argnums=(0,) if donate else ())
+        def _insert(state, batch):
+            return _strong(c_buffer(buffer.insert_episode_batch(state,
+                                                                batch)))
+
+        insert = jax.jit(_insert, donate_argnums=(0,) if donate else ())
 
         def _train_iter(ts: TrainState, key: jax.Array, t_env: jnp.ndarray):
             """sample → train → priority feedback, as one program."""
@@ -195,7 +217,8 @@ class Experiment:
                 k_learn)
             buf = buffer.update_priorities(
                 ts.buffer, idx, info["td_errors_abs"] + 1e-6)   # Q9
-            return ts.replace(learner=learner_state, buffer=buf), info
+            return _strong(ts.replace(learner=c_learner(learner_state),
+                                      buffer=c_buffer(buf))), info
 
         return rollout, insert, jax.jit(
             _train_iter, donate_argnums=(0,) if donate else ())

@@ -147,6 +147,33 @@ def test_dp_devices_drives_training_from_config_alone(tmp_path):
     assert int(jax.device_get(ts2.runner.t_env)) > step
 
 
+def test_chained_programs_compile_exactly_once(tmp_path):
+    """The driver loop feeds every program output back in as an input; a
+    weak_type or placement drift in ANY chained leaf (e.g. a
+    Python-scalar jnp.where branch in the env step) silently compiles a
+    second executable of the whole program on iteration 2 — at config-3
+    chip scale that's ~30 s of extra compile per program per run. The
+    jitted_programs boundary strips weak types; this pins it."""
+    import jax.numpy as jnp
+    cfg = tiny_cfg(tmp_path, replay_kw=dict(prioritized=True))
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(0)
+    rollout, insert, train_iter = exp.jitted_programs()
+    key = jax.random.PRNGKey(0)
+    t_env = 0
+    for i in range(3):
+        rs, batch, _ = rollout(ts.learner.params["agent"], ts.runner,
+                               test_mode=False)
+        ts = ts.replace(runner=rs, buffer=insert(ts.buffer, batch),
+                        episode=ts.episode + cfg.batch_size_run)
+        t_env += cfg.batch_size_run * cfg.env_args.episode_limit
+        ts, _ = train_iter(ts, jax.random.fold_in(key, i),
+                           jnp.asarray(t_env))
+    assert rollout._cache_size() == 1
+    assert insert._cache_size() == 1
+    assert train_iter._cache_size() == 1
+
+
 def test_sanity_rejects_unknown_prng_impl():
     with pytest.raises(ValueError, match="prng_impl"):
         sanity_check(TrainConfig(prng_impl="philox"))

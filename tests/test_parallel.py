@@ -69,6 +69,29 @@ def test_sharded_rollout_and_train_step(dp_setup):
     assert leaf.sharding.is_fully_replicated
 
 
+def test_dp_chained_programs_compile_exactly_once(dp_setup):
+    """Same single-compile pin as the single-chip variant
+    (tests/test_driver.py), but over the mesh: the DataParallel output
+    constraints must return every chained state at the exact placement
+    ``shard`` gives its inputs, or iteration 2 runs a second
+    differently-sharded executable."""
+    cfg, exp, dp, ts = dp_setup
+    rollout, insert, train_iter = dp.jitted_programs()
+    key = jax.random.PRNGKey(3)
+    t_env = 0
+    for i in range(3):
+        rs, batch, _ = rollout(ts.learner.params["agent"], ts.runner,
+                               test_mode=False)
+        ts = ts.replace(runner=rs, buffer=insert(ts.buffer, batch),
+                        episode=ts.episode + cfg.batch_size_run)
+        t_env += cfg.batch_size_run * cfg.env_args.episode_limit
+        ts, _ = train_iter(ts, jax.random.fold_in(key, i),
+                           jnp.asarray(t_env))
+    assert rollout._cache_size() == 1
+    assert insert._cache_size() == 1
+    assert train_iter._cache_size() == 1
+
+
 def test_dp_matches_single_device_loss(dp_setup):
     """The sharded loss equals the unsharded loss on identical inputs —
     the DP axis is arithmetic-neutral."""
