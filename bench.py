@@ -541,6 +541,16 @@ def bench_all(make_cfg, _time, _pipe_rate, args) -> int:
             print(f"# {label} rollout failed: {e!r}", file=sys.stderr)
         gc.collect()
 
+    # 3b. PRNG-impl comparison at config 3: leg 1 is the threefry
+    #     baseline; rbg routes every draw through the TPU hardware bit
+    #     generator (the record carries the live impl)
+    try:
+        emit(rollout_rate(make_cfg("qslice", 3, prng="rbg"),
+                          "entity/qslice", {"config": cid(3)}))
+    except Exception as e:                  # pragma: no cover - defensive
+        print(f"# rbg rollout failed: {e!r}", file=sys.stderr)
+    gc.collect()
+
     # 4. breakdown attribution at config 3 (its own JSON line)
     try:
         exp = Experiment.build(cfg3)
@@ -599,9 +609,9 @@ def main() -> int:
     ap.add_argument("--all", action="store_true",
                     help="comprehensive single-process sweep: default "
                          "rollout+train line, breakdown, pallas/dense "
-                         "comparison, config-4 scale — one backend init, "
-                         "one JSON line per measurement (tunnel-scarce "
-                         "mode)")
+                         "comparison, threefry/rbg comparison, config-4 "
+                         "scale — one backend init, one JSON line per "
+                         "measurement (tunnel-scarce mode)")
     ap.add_argument("--hbm", action="store_true",
                     help="print the analytic device-memory budget for the "
                          "selected config (no device work)")
@@ -720,11 +730,11 @@ def main() -> int:
         # per-step; the full 150-slot episode batch at entity obs 64×576
         # would exceed single-chip HBM — the training config shards it over
         # the data axis instead).
-        def make_cfg(acting: str, config_id: int):
+        def make_cfg(acting: str, config_id: int, prng: str | None = None):
             c = _CONFIGS[config_id]
             return sanity_check(TrainConfig(
                 batch_size_run=args.envs or c["envs"],
-                prng_impl=args.prng,
+                prng_impl=prng or args.prng,
                 env_args=EnvConfig(agv_num=c["agv"], mec_num=c["mec"],
                                    num_channels=c["ch"],
                                    episode_limit=args.steps or 32,
@@ -791,13 +801,15 @@ def main() -> int:
         if args.smoke:
             raise SystemExit("--all is a full-scale chip mode; drop --smoke")
         if (args.config != 3 or args.acting != "qslice" or args.train
-                or args.breakdown):
+                or args.breakdown or args.prng != "threefry"):
             # --all owns its measurement matrix; silently ignoring these
-            # would misattribute records
+            # would misattribute records (and a non-default --prng would
+            # turn the leg-1 headline into rbg with no threefry baseline)
             raise SystemExit(
                 "--all runs its own fixed measurement set (config-3 "
-                "headline + config-4 train + pallas/dense + breakdown); "
-                "drop --config/--acting/--train/--breakdown")
+                "headline + config-4 train + pallas/dense + "
+                "threefry/rbg + breakdown); drop "
+                "--config/--acting/--train/--breakdown/--prng")
         with tracing():
             return bench_all(make_cfg, _time, _pipe_rate, args)
 
