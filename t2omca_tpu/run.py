@@ -294,6 +294,9 @@ def run_sequential(exp: Experiment, logger: Logger,
     last_log_t = t_env
     last_save_t = t_env if t_env else -cfg.save_model_interval - 1
     start_time = last_time = time.time()
+    last_log_time = None     # set at the first flush: the first window is
+    # dominated by the rollout/train compiles (~30s+ on chip) and would
+    # log a wildly-low throughput outlier
     start_t = last_T = t_env
     n_test_runs = max(1, cfg.test_nepisode // cfg.batch_size_run)
     test_quota = n_test_runs * cfg.batch_size_run      # Q10 rounded quota
@@ -430,6 +433,17 @@ def run_sequential(exp: Experiment, logger: Logger,
                     logger.log_stat(k, float(last[k]), t_env)
                 train_infos = []
             logger.log_stat("episode", episode, t_env)
+            # wall-clock throughput including everything (train, logging,
+            # cadences) — the honest live rate; the async loop makes the
+            # per-stage timings dispatch-enqueue times unless
+            # profile_stages is on
+            now = time.time()
+            if last_log_time is not None:
+                logger.log_stat(
+                    "env_steps_per_sec",
+                    (t_env - last_log_t) / max(now - last_log_time, 1e-9),
+                    t_env)
+            last_log_time = now
             timer.log_and_reset(logger, t_env)
             logger.print_recent_stats()
             last_log_t = t_env
