@@ -44,6 +44,16 @@ class EnvConfig:
     # (runs/config1_faststack/SUMMARY.md). Reference-exact parity configs
     # (sequential normalizer ordering) opt out with fast_norm=False.
     fast_norm: bool = True
+    # train-time reward scaling (the reference env imports RewardScaling
+    # but the released slice never instantiates it — provided wired): each
+    # env lane divides its recorded rewards by the running std of its
+    # discounted return (envs/normalization.py scale_reward; the
+    # discounted-return accumulator resets at episode start, the running
+    # std persists across episodes). Logged returns/metrics stay RAW;
+    # only the replay-recorded reward the learner trains on is scaled.
+    # Off by default — changes the loss scale, so parity configs must not
+    # enable it.
+    reward_scaling: bool = False
 
     # ----- physics / M1 spec values (frozen in docs/SPEC.md §1; the reference
     # does not release data_struct_multiagv, so these are our pinned choices)

@@ -90,10 +90,17 @@ class DataParallel:
         episodes sharded over the data axis."""
         env_sharded = shard_episode_axis(ts.runner.env_states, self.mesh,
                                          self.axis)
+        # reward-scale state is per-lane except the scalar Welford count
+        lane = NamedSharding(self.mesh, P(self.axis))
+        rep = NamedSharding(self.mesh, P())
+        rscale = jax.tree.map(
+            lambda x: jax.device_put(x, lane if x.ndim else rep),
+            ts.runner.rscale)
         runner = ts.runner.replace(
             env_states=env_sharded,
             key=replicate(ts.runner.key, self.mesh),
-            t_env=replicate(ts.runner.t_env, self.mesh))
+            t_env=replicate(ts.runner.t_env, self.mesh),
+            rscale=rscale)
         storage = shard_episode_axis(ts.buffer.storage, self.mesh, self.axis)
         buffer = ts.buffer.replace(
             storage=storage,
@@ -135,7 +142,9 @@ class DataParallel:
                 env_states=jax.tree.map(lambda x: wsc(x, data),
                                         rs.env_states),
                 key=wsc(rs.key, rep),
-                t_env=wsc(rs.t_env, rep))
+                t_env=wsc(rs.t_env, rep),
+                rscale=jax.tree.map(
+                    lambda x: wsc(x, data if x.ndim else rep), rs.rscale))
 
         def constrain_buffer(buf):
             return buf.replace(

@@ -42,6 +42,8 @@ from ..components.episode_buffer import CompactEntityObs, EpisodeBatch
 from ..config import TrainConfig
 from ..controllers.basic_mac import BasicMAC
 from ..envs.mec_offload import EnvState, MultiAgvOffloadingEnv
+from ..envs.normalization import (RewardScaleState, reset_reward_scale,
+                                  scale_reward)
 
 
 @struct.dataclass
@@ -51,6 +53,10 @@ class RunnerState:
     env_states: EnvState      # batched (B, ...) — holds the persistent norms
     key: jnp.ndarray          # PRNG key
     t_env: jnp.ndarray        # () int32 — global env-step cursor
+    # per-lane reward-scaling state (envs/normalization.RewardScaleState;
+    # active only under env_args.reward_scaling, but always carried so the
+    # checkpoint pytree is config-independent)
+    rscale: RewardScaleState
 
 
 @struct.dataclass
@@ -108,8 +114,11 @@ class ParallelRunner:
         key, k_reset = jax.random.split(key)
         states, *_ = jax.vmap(self.env.reset)(
             jax.random.split(k_reset, self.batch_size))
-        return RunnerState(env_states=states, key=key,
-                           t_env=jnp.zeros((), jnp.int32))
+        return RunnerState(
+            env_states=states, key=key,
+            t_env=jnp.zeros((), jnp.int32),
+            rscale=RewardScaleState.create(gamma=self.cfg.gamma,
+                                           dim=self.batch_size))
 
     # ------------------------------------------------------------------ rollout
 
@@ -153,8 +162,16 @@ class ParallelRunner:
                 mec_index=env_states.mec_index.astype(jnp.int8),
                 mean=mean, std=std)
 
+        # reward scaling (env_args.reward_scaling): the discounted-return
+        # accumulator resets each episode, the running std persists (C2
+        # RewardScaling semantics). Train rollouts only — eval batches are
+        # never trained on, and updating the std from greedy episodes
+        # would perturb the training scale across test cadences.
+        scale_on = self.cfg.env_args.reward_scaling and not test_mode
+        rscale0 = reset_reward_scale(rs.rscale)
+
         def step_fn(carry, key_t):
-            env_states, obs, gstate, avail, hidden, t_env = carry
+            env_states, obs, gstate, avail, hidden, t_env, rscale = carry
             k_act, k_env = jax.random.split(key_t)
             # entity-table acting / compact storage: the factored obs is a
             # pure function of the carried env state (same post-update norm
@@ -178,16 +195,21 @@ class ParallelRunner:
             env_states, reward, terminated, info, obs, gstate, avail = \
                 jax.vmap(self.env.step)(
                     env_states, actions, jax.random.split(k_env, b))
+            if scale_on:
+                rscale, rec_reward = scale_reward(rscale, reward)
+            else:
+                rec_reward = reward
             env_terminal = terminated & ~info.episode_limit        # Q7
-            ys = (pre, reward, env_terminal, info, eps,
+            ys = (pre, reward, rec_reward, env_terminal, info, eps,
                   (viz + (env_states.last_ack,)) if capture else ())
             t_env = t_env + jnp.where(jnp.asarray(test_mode), 0, b)
-            return (env_states, obs, gstate, avail, hidden, t_env), ys
+            return (env_states, obs, gstate, avail, hidden, t_env,
+                    rscale), ys
 
-        carry = (env_states, obs, gstate, avail, hidden, rs.t_env)
+        carry = (env_states, obs, gstate, avail, hidden, rs.t_env, rscale0)
         carry, ys = jax.lax.scan(step_fn, carry, jax.random.split(k_scan, t_len))
-        env_states, last_obs, last_gstate, last_avail, _, t_env = carry
-        (pre, reward, env_terminal, info, eps, viz_seq) = ys
+        env_states, last_obs, last_gstate, last_avail, _, t_env, rscale = carry
+        (pre, reward, rec_reward, env_terminal, info, eps, viz_seq) = ys
         obs_seq, gstate_seq, avail_seq, action_seq = pre
 
         # (T, B, ...) → (B, T, ...), with the bootstrap step appended
@@ -207,7 +229,7 @@ class ParallelRunner:
             state=cat_last(gstate_seq, last_gstate.astype(sd)),
             avail_actions=cat_last(avail_seq, last_avail > 0),
             actions=bt(action_seq),
-            reward=bt(reward),
+            reward=bt(rec_reward),   # scaled under reward_scaling; else raw
             terminated=bt(env_terminal),
             filled=jnp.ones((b, t_len), bool),
         )
@@ -226,7 +248,8 @@ class ParallelRunner:
             task_completion_delay=last(info.task_completion_delay),
             epsilon=eps[-1],
         )
-        new_rs = RunnerState(env_states=env_states, key=key, t_env=t_env)
+        new_rs = RunnerState(env_states=env_states, key=key, t_env=t_env,
+                             rscale=rscale if scale_on else rs.rscale)
         if capture:
             pos_seq, mec_seq, ack_seq = viz_seq
             viz = {"pos": pos_seq, "mec_index": mec_seq, "acks": ack_seq,

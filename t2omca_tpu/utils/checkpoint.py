@@ -24,8 +24,9 @@ import jax
 from flax import serialization
 
 #: bump when the checkpointed pytree layout changes incompatibly
-#: (v2: bool avail storage + meta sidecar)
-FORMAT_VERSION = 2
+#: (v2: bool avail storage + meta sidecar; v3: RunnerState carries the
+#: per-lane reward-scale state)
+FORMAT_VERSION = 3
 
 
 class CheckpointFormatError(ValueError):
@@ -80,6 +81,7 @@ def load_checkpoint(dirname: str, target: Any) -> Any:
     ``meta.json`` sidecar (when present) turns a replay-layout mismatch
     into a precise config instruction before any deserialization."""
     meta_path = os.path.join(dirname, "meta.json")
+    meta = None
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
@@ -102,7 +104,18 @@ def load_checkpoint(dirname: str, target: Any) -> Any:
     with open(os.path.join(dirname, "state.msgpack"), "rb") as f:
         data = f.read()
     try:
-        restored = serialization.from_bytes(target, data)
+        if meta is not None and meta.get("format", 0) < 3:
+            # v2 → v3 migration: v3 added RunnerState.rscale. No v2 run
+            # could have had reward_scaling on (the field did not exist),
+            # so injecting the template's fresh (all-zero) reward-scale
+            # state-dict is lossless — replay contents, normalizer stats,
+            # and RNG state all restore exactly.
+            raw = serialization.msgpack_restore(data)
+            raw["runner"]["rscale"] = serialization.to_state_dict(
+                jax.device_get(target.runner.rscale))
+            restored = serialization.from_state_dict(target, raw)
+        else:
+            restored = serialization.from_bytes(target, data)
     except (KeyError, ValueError) as e:
         raise ValueError(
             f"checkpoint {dirname} does not match the configured train-state "

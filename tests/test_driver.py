@@ -147,6 +147,46 @@ def test_dp_devices_drives_training_from_config_alone(tmp_path):
     assert int(jax.device_get(ts2.runner.t_env)) > step
 
 
+def test_v2_checkpoint_migrates_to_v3_exactly(tmp_path):
+    """Format v3 added RunnerState.rscale; a v2 full-state checkpoint (no
+    such field, reward_scaling could not have been on) must still restore
+    EXACTLY via the migration shim — replay, normalizer stats, and RNG
+    state intact, reward-scale state fresh."""
+    import json as _json
+    from flax import serialization
+    from t2omca_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+    cfg = tiny_cfg(tmp_path)
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(0)
+    d = save_checkpoint(str(tmp_path / "ckpt"), 40, ts)
+
+    # doctor the on-disk checkpoint into v2: strip runner.rscale and mark
+    # the meta format
+    with open(os.path.join(d, "state.msgpack"), "rb") as f:
+        raw = serialization.msgpack_restore(f.read())
+    del raw["runner"]["rscale"]
+    with open(os.path.join(d, "state.msgpack"), "wb") as f:
+        f.write(serialization.msgpack_serialize(raw))
+    meta_p = os.path.join(d, "meta.json")
+    meta = _json.load(open(meta_p))
+    meta["format"] = 2
+    _json.dump(meta, open(meta_p, "w"))
+
+    restored = load_checkpoint(d, exp.init_train_state(3))
+    # everything except rscale restored exactly from the v2 file
+    for (kp, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(jax.device_get(ts)),
+            jax.tree_util.tree_leaves_with_path(jax.device_get(restored))):
+        if ".rscale" in jax.tree_util.keystr(kp):
+            continue
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=jax.tree_util.keystr(kp))
+    # rscale came back fresh (all zeros)
+    assert all(float(np.asarray(x).sum()) == 0.0
+               for x in jax.tree_util.tree_leaves(restored.runner.rscale))
+
+
 def test_chained_programs_compile_exactly_once(tmp_path):
     """The driver loop feeds every program output back in as an input; a
     weak_type or placement drift in ANY chained leaf (e.g. a

@@ -306,3 +306,89 @@ def test_remat_noisy_path_gradients_flow(noisy_setup):
     assert all(np.isfinite(np.asarray(g)).all() for g in leaves)
     q_grads = grads["agent"]["params"]["q_basic"]
     assert np.abs(np.asarray(q_grads["w_sigma"])).max() > 0
+
+
+# ---------------------------------------------------------------- reward scaling
+
+def _rscale_cfg():
+    return sanity_check(TrainConfig(
+        batch_size_run=2,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=6, reward_scaling=True),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1),
+        replay=ReplayConfig(buffer_size=8),
+    ))
+
+
+def test_reward_scaling_matches_per_lane_oracle():
+    """env_args.reward_scaling: recorded rewards are raw/(std(G)+1e-8)
+    per lane (C2 RewardScaling semantics, reference normalization.py:38-52
+    — imported by the env, never instantiated in the released slice);
+    stats/returns stay RAW; the discounted return resets per episode while
+    the running std persists."""
+    cfg = _rscale_cfg()
+    env = make_env(cfg.env_args)
+    info = env.get_env_info()
+    mac = BasicMAC.build(cfg, info)
+    learner = QMixLearner.build(cfg, mac, info)
+    runner = ParallelRunner(env, mac, cfg)
+    ls = learner.init_state(jax.random.PRNGKey(0))
+    rs = runner.init_state(jax.random.PRNGKey(1))
+    run = jax.jit(runner.run, static_argnames="test_mode")
+
+    import dataclasses
+    raw_cfg = cfg.replace(env_args=dataclasses.replace(
+        cfg.env_args, reward_scaling=False))
+    raw_runner = ParallelRunner(env, mac, raw_cfg)
+    rs_raw = raw_runner.init_state(jax.random.PRNGKey(1))
+    raw_run = jax.jit(raw_runner.run, static_argnames="test_mode")
+
+    rs2, batch, stats = run(ls.params["agent"], rs, test_mode=False)
+    _, batch_raw, stats_raw = raw_run(ls.params["agent"], rs_raw,
+                                      test_mode=False)
+    raw = np.asarray(batch_raw.reward, np.float64)       # (B, T)
+    scaled = np.asarray(batch.reward, np.float64)
+
+    # oracle: sequential per-lane Welford over the discounted return
+    gamma = cfg.gamma
+    B, T = raw.shape
+    expect = np.zeros_like(raw)
+    for lane in range(B):
+        g, n, mean, s, std = 0.0, 0, 0.0, 0.0, 0.0
+        for t in range(T):
+            g = gamma * g + raw[lane, t]
+            n += 1
+            if n == 1:
+                mean, std = g, g          # Q5 first-sample quirk
+            else:
+                old = mean
+                mean += (g - old) / n
+                s += (g - old) * (g - mean)
+                std = np.sqrt(s / n)
+            expect[lane, t] = raw[lane, t] / (std + 1e-8)
+    np.testing.assert_allclose(scaled, expect, rtol=2e-4)
+
+    # metrics stay raw: identical trajectories => identical raw returns
+    np.testing.assert_allclose(np.asarray(stats.episode_return),
+                               np.asarray(stats_raw.episode_return),
+                               rtol=1e-6)
+
+    # cross-episode: std persists, discounted return resets
+    rs3, batch2, _ = run(ls.params["agent"], rs2, test_mode=False)
+    assert int(np.asarray(rs3.rscale.norm.n)) == 2 * T
+    # test mode leaves the scale state untouched
+    rs4, _, _ = run(ls.params["agent"], rs3, test_mode=True)
+    assert int(np.asarray(rs4.rscale.norm.n)) == 2 * T
+
+
+def test_reward_scaling_welford_matches_reference_quirk():
+    """First scaled sample divides by std = G_0 itself — SIGNED (Q5,
+    reference normalization.py:16-18)."""
+    from t2omca_tpu.envs.normalization import (RewardScaleState,
+                                               scale_reward)
+    st = RewardScaleState.create(gamma=0.9, dim=2)
+    x = jnp.asarray([2.0, -3.0])
+    st, y = scale_reward(st, x)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(x) / (np.asarray(x) + 1e-8), rtol=1e-6)
