@@ -201,6 +201,30 @@ class TrainConfig:
     grad_norm_clip: float = 10.0
     target_update_interval: int = 200     # episodes between hard target syncs
     double_q: bool = True
+    # ----- loss-scale levers. Per-step rewards are O(10^2) (latency units,
+    # docs/SPEC.md §1), so unweighted MSE on TD errors of that scale drives
+    # grad_norm to 1e4-1e5 against grad_norm_clip=10 — every update is a
+    # direction-only step of size clip*lr (measured:
+    # runs/config1_stable/metrics_rbg_seed0.jsonl grad_norm=193k). Two
+    # spec-level remedies, both OFF by default so reference-parity configs
+    # and all committed learning evidence are byte-identical:
+    # td_loss="huber": elementwise 2x-scaled Huber — td^2 inside
+    # |td|<=huber_delta, 2*delta*|td|-delta^2 outside — so the quadratic
+    # region matches the default MSE exactly and delta->inf recovers it.
+    # The DQN-lineage gradient bound: each TD element contributes at most
+    # 2*delta to dLoss/dq_tot.
+    td_loss: str = "mse"                  # mse | huber
+    huber_delta: float = 10.0             # Huber transition point (TD units)
+    # reward_unit: divide the TRAIN-TIME reward by this constant (e.g.
+    # latency_max_ms=100 makes per-step rewards O(1)); the value function
+    # and the learner's logged metrics (loss/td_error_abs/target_mean)
+    # are in reward/reward_unit units, while the runner's episode
+    # returns/rewards stay raw. Unlike env_args.reward_scaling
+    # (running-std, state-dependent — provably harmful at config 2,
+    # runs/config2_scaling/SUMMARY.md) this is a static unit choice: no
+    # state, no checkpoint migration, exact. Mutually exclusive with
+    # reward_scaling (sanity_check) — combining would double-scale.
+    reward_unit: float = 1.0
 
     # action selection
     action_selector: str = "epsilon_greedy"   # epsilon_greedy | noisy-new
@@ -230,6 +254,17 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
     if cfg.prng_impl not in ("threefry", "rbg", "unsafe_rbg"):
         raise ValueError(f"prng_impl must be threefry/rbg/unsafe_rbg, "
                          f"got {cfg.prng_impl!r}")
+    if cfg.td_loss not in ("mse", "huber"):
+        raise ValueError(f"td_loss must be mse/huber, got {cfg.td_loss!r}")
+    if cfg.td_loss == "huber" and cfg.huber_delta <= 0:
+        raise ValueError(f"huber_delta must be > 0, got {cfg.huber_delta}")
+    if cfg.reward_unit <= 0:
+        raise ValueError(f"reward_unit must be > 0, got {cfg.reward_unit}")
+    if cfg.reward_unit != 1.0 and cfg.env_args.reward_scaling:
+        raise ValueError(
+            "reward_unit and env_args.reward_scaling are alternative "
+            "reward-scale remedies; enabling both would double-scale the "
+            "train-time reward (running-std AND /reward_unit) — pick one")
     if cfg.model.standard_heads:
         if cfg.model.emb % cfg.model.heads or cfg.model.mixer_emb % cfg.model.mixer_heads:
             raise ValueError(

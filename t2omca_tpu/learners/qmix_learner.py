@@ -335,11 +335,31 @@ class QMixLearner:
             obs, k_tmx)[1:]   # obs may be None (compact storage: the
         # state-entity mixer never reads it)
 
+        # reward_unit: static train-time unit normalization (the value
+        # function is learned in reward/reward_unit units; logged returns
+        # stay raw — see config.py loss-scale levers). 1.0 = off, exact.
+        if cfg.reward_unit != 1.0:
+            reward = reward / cfg.reward_unit
         targets = reward + cfg.gamma * (1.0 - term) * target_q_tot
         td = (q_tot - jax.lax.stop_gradient(targets)) * mask
 
         denom = jnp.maximum(mask.sum(), 1.0)
-        loss = (weights[None, :] * td ** 2).sum() / denom
+        if cfg.td_loss == "huber":
+            # 2x-scaled Huber: td^2 inside |td|<=delta (matches the MSE
+            # branch exactly), linear with slope 2*delta outside — bounds
+            # each element's dLoss/dq_tot at 2*delta (config.py rationale).
+            # Deliberately NOT optax.huber_loss: its min()-based form
+            # accumulates backward cotangents as q + delta - delta, which
+            # cancels catastrophically in f32 once delta >> |td| (grads of
+            # small TDs round to 0 at delta=1e9, breaking the delta->inf
+            # == MSE identity the tests pin); branch selection via where
+            # keeps each cotangent path exact at any delta.
+            d = cfg.huber_delta
+            abs_td = jnp.abs(td)
+            elem = jnp.where(abs_td <= d, td ** 2, 2.0 * d * abs_td - d * d)
+        else:
+            elem = td ** 2
+        loss = (weights[None, :] * elem).sum() / denom
 
         ep_mask = jnp.maximum(mask.sum(axis=0), 1.0)
         info = {

@@ -1,0 +1,146 @@
+"""Loss-scale levers (config.py: td_loss / huber_delta / reward_unit).
+
+VERDICT r4 weak #2: per-step rewards are O(10^2) so the default MSE drives
+grad_norm to 1e4-1e5 against grad_norm_clip=10 — every update is clipped to
+a direction-only step. These tests pin the two flag-gated remedies:
+
+- ``td_loss="huber"`` (2x-scaled Huber): exactly the MSE inside
+  ``|td| <= huber_delta`` and linear outside, so delta->inf IS the MSE and
+  each TD element's gradient contribution is bounded by 2*delta.
+- ``reward_unit=u``: training with it is bit-identical to training with
+  rewards pre-divided by u (static unit change, no state).
+
+Both default OFF; the defaults-guard test keeps every parity config and all
+committed learning evidence byte-identical.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from t2omca_tpu.components import PrioritizedReplayBuffer
+from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,
+                               TrainConfig, sanity_check)
+from t2omca_tpu.controllers import BasicMAC
+from t2omca_tpu.envs.registry import make_env
+from t2omca_tpu.learners import QMixLearner
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = sanity_check(TrainConfig(
+        batch_size_run=2, batch_size=3,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=6, fast_norm=False),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1),
+        replay=ReplayConfig(buffer_size=10),
+    ))
+    env = make_env(cfg.env_args)
+    info = env.get_env_info()
+    mac = BasicMAC.build(cfg, info)
+    learner = QMixLearner.build(cfg, mac, info)
+    ls = learner.init_state(jax.random.PRNGKey(0))
+
+    from t2omca_tpu.runners import ParallelRunner
+    runner = ParallelRunner(env, mac, cfg)
+    rs = runner.init_state(jax.random.PRNGKey(1))
+    run = jax.jit(runner.run, static_argnames="test_mode")
+    rs, batch, _ = run(ls.params["agent"], rs, test_mode=False)
+    buf = PrioritizedReplayBuffer(
+        capacity=10, episode_limit=cfg.env_args.episode_limit,
+        n_agents=info["n_agents"], n_actions=info["n_actions"],
+        obs_dim=info["obs_shape"], state_dim=info["state_shape"],
+        alpha=0.6, beta0=0.4, t_max=1000)
+    bs = buf.insert_episode_batch(buf.init(), batch)
+    sample, idx, w = buf.sample(bs, jax.random.PRNGKey(2), cfg.batch_size, 0)
+    return cfg, learner, ls, sample, w
+
+
+def _with_cfg(learner, **kw):
+    return dataclasses.replace(learner, cfg=learner.cfg.replace(**kw))
+
+
+def _loss_and_grads(learner, ls, sample, w):
+    grads, info = jax.grad(learner._loss, has_aux=True)(
+        ls.params, ls.target_params, sample, w)
+    import optax
+    return float(info["loss"]), float(optax.global_norm(grads)), grads
+
+
+def test_levers_off_by_default():
+    cfg = TrainConfig()
+    assert cfg.td_loss == "mse"
+    assert cfg.reward_unit == 1.0
+
+
+def test_huber_inf_delta_matches_mse(setup):
+    cfg, learner, ls, sample, w = setup
+    l_mse, g_mse, grads_mse = _loss_and_grads(learner, ls, sample, w)
+    hub = _with_cfg(learner, td_loss="huber", huber_delta=1e9)
+    l_h, g_h, grads_h = _loss_and_grads(hub, ls, sample, w)
+    assert l_h == l_mse
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
+                 grads_mse, grads_h)
+
+
+def test_huber_bounds_gradient_scale(setup):
+    cfg, learner, ls, sample, w = setup
+    # inflate rewards 1000x: the MSE gradient explodes linearly with the
+    # TD scale; the Huber gradient is bounded per element by 2*delta
+    big = dataclasses.replace(sample, reward=sample.reward * 1000.0)
+    _, g_mse, _ = _loss_and_grads(learner, ls, big, w)
+    hub = _with_cfg(learner, td_loss="huber", huber_delta=1.0)
+    _, g_h, _ = _loss_and_grads(hub, ls, big, w)
+    assert g_h < g_mse / 50.0
+    # and it is still a descent signal, not zero
+    assert g_h > 0.0
+
+
+def test_reward_unit_equals_prescaled_rewards(setup):
+    cfg, learner, ls, sample, w = setup
+    u = 100.0
+    lev = _with_cfg(learner, reward_unit=u)
+    l_a, g_a, grads_a = _loss_and_grads(lev, ls, sample, w)
+    pre = dataclasses.replace(sample, reward=sample.reward / u)
+    l_b, g_b, grads_b = _loss_and_grads(learner, ls, pre, w)
+    assert l_a == l_b
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
+                 grads_a, grads_b)
+
+
+def test_reward_unit_shrinks_gradients(setup):
+    cfg, learner, ls, sample, w = setup
+    _, g_raw, _ = _loss_and_grads(learner, ls, sample, w)
+    lev = _with_cfg(learner, reward_unit=100.0)
+    _, g_u, _ = _loss_and_grads(lev, ls, sample, w)
+    assert g_u < g_raw
+
+
+def test_train_step_with_levers_runs_and_is_finite(setup):
+    cfg, learner, ls, sample, w = setup
+    lev = _with_cfg(learner, td_loss="huber", huber_delta=10.0,
+                    reward_unit=100.0)
+    ls2, info = jax.jit(lev.train)(ls, sample, w, jnp.asarray(0),
+                                   jnp.asarray(2))
+    assert np.isfinite(float(info["loss"]))
+    assert np.isfinite(float(info["grad_norm"]))
+    changed = jax.tree.map(lambda a, b: not np.allclose(a, b),
+                           ls.params, ls2.params)
+    assert any(jax.tree.leaves(changed))
+
+
+def test_sanity_check_validates_lever_flags():
+    with pytest.raises(ValueError, match="td_loss"):
+        sanity_check(TrainConfig(td_loss="l1"))
+    with pytest.raises(ValueError, match="huber_delta"):
+        sanity_check(TrainConfig(td_loss="huber", huber_delta=0.0))
+    with pytest.raises(ValueError, match="reward_unit"):
+        sanity_check(TrainConfig(reward_unit=-1.0))
+    with pytest.raises(ValueError, match="double-scale"):
+        sanity_check(TrainConfig(
+            reward_unit=100.0,
+            env_args=EnvConfig(reward_scaling=True)))
