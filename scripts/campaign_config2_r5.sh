@@ -1,0 +1,39 @@
+#!/bin/bash
+# Config-2 learning campaign, round 5: the loss-scale recipe.
+#
+# Round-4 root-cause (VERDICT r4 weak #2): grad_norm 2e4-2e5 against
+# grad_norm_clip=10 — every update was a direction-only step, and the
+# conflict-storm episodes (per-step reward O(-500)) dominated each MSE
+# batch gradient. Recipe, three legs:
+#   reward_unit=100    latency_max_ms — per-step rewards O(1-5) in train
+#                      units, so clipping becomes inactive;
+#   td_loss=huber d=10 storm outliers bounded, quadratic elsewhere;
+#   mixer_zero_init    ReZero gate: the mixer's init output is O(emb)
+#                      (measured +-600 at emb=128) — without the gate the
+#                      early bootstrap targets are init noise 100x the
+#                      unit-normalized reward signal.
+# Everything else is the stable-sweep default set (lr 5e-4, eps floor 0.1).
+# Recipe validated on config 1 first: seed 0 mean-last-3 = 7987 vs bar
+# 7189, grad_norm tail O(10) vs the old 2e4-2e5.
+#
+# Usage: nohup scripts/campaign_config2_r5.sh [outdir] [seeds...] &
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-/tmp/config2_r5}
+shift || true
+SEEDS=${@:-0 1 2}
+mkdir -p "$OUT"
+for s in $SEEDS; do
+  echo "[campaign] seed $s start $(date -u +%FT%TZ)" >> "$OUT/campaign.log"
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m t2omca_tpu.run train \
+    --config configs/config1_cpu_parity.yaml \
+    env_args.fast_norm=true env_args.agv_num=16 env_args.mec_num=4 \
+    model.emb=128 model.mixer_emb=128 \
+    reward_unit=100.0 td_loss=huber huber_delta=10.0 \
+    model.mixer_zero_init=true \
+    seed=$s save_model=false log_interval=2000 \
+    local_results_path="$OUT/seed$s" \
+    >> "$OUT/seed${s}.log" 2>&1
+  echo "[campaign] seed $s done rc=$? $(date -u +%FT%TZ)" >> "$OUT/campaign.log"
+done
+echo "[campaign] ALL DONE" >> "$OUT/campaign.log"

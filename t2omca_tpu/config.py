@@ -97,6 +97,15 @@ class ModelConfig:
     # materializing per-agent token embeddings; exact for entity-mode obs
     # under fast_norm, auto-disabled otherwise
     use_entity_tables: bool = True
+    # ReZero-style zero-init gate on the mixer output (q_tot = gate * y,
+    # gate a scalar param init 0). The transformer mixer's readout
+    # contracts emb-many O(1) post-LN token entries against abs-positive
+    # weights, so its INIT output scale grows ~linearly with emb
+    # (measured O(+-600) at emb=128/16 agents) — garbage early bootstrap
+    # targets that dwarf unit-normalized rewards. Off by default
+    # (reference-parity init); the config-2 learning recipe turns it on
+    # together with reward_unit/td_loss (scripts/campaign_config2_r5.sh).
+    mixer_zero_init: bool = False
     # rematerialize the learner's per-timestep forwards in the backward
     # pass (jax.checkpoint around the scan bodies): trades ~1 extra
     # forward for O(T) less residual HBM — the standard TPU lever for

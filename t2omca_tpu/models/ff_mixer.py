@@ -44,6 +44,7 @@ class QMixFFMixer(nn.Module):
     dtype: jnp.dtype = jnp.float32
     hypernet_layers: int = 2
     hypernet_emb: int = 64
+    zero_init_gate: bool = False   # ReZero output gate (see models/mixer.py)
 
     def pos_func(self, x: jax.Array) -> jax.Array:
         return qmix_pos_func(x, self.qmix_pos_func, self.qmix_pos_func_beta)
@@ -84,6 +85,8 @@ class QMixFFMixer(nn.Module):
 
         hidden = nn.elu(jnp.matmul(qvals.astype(jnp.float32), w1) + b1)
         y = jnp.matmul(hidden, w2) + b2
+        if self.zero_init_gate:
+            y = y * self.param("out_gate", nn.initializers.zeros, (1,))
         return y, hyper_weights          # non-recurrent: carry unchanged
 
     def initial_hyper(self, batch_size: int) -> jax.Array:
@@ -108,6 +111,8 @@ class VDNMixer(nn.Module):
     standard_heads: bool = False
     use_orthogonal: bool = False
     dtype: jnp.dtype = jnp.float32
+    zero_init_gate: bool = False   # accepted for registry-uniform kwargs;
+    # a parameterless sum has no init-scale pathology to gate
 
     @nn.compact
     def __call__(self, qvals: jax.Array, hidden_states: jax.Array,

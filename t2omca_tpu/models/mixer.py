@@ -60,6 +60,17 @@ class TransformerMixer(nn.Module):
     standard_heads: bool = False
     use_orthogonal: bool = False
     dtype: jnp.dtype = jnp.float32
+    # ReZero-style zero-init output gate (off = reference-parity init).
+    # The readout q_tot = elu(q·|w1| + b1)·|w2| + b2 contracts emb-many
+    # O(1) post-LN token entries against abs-positive weights, so its init
+    # scale grows ~linearly with emb: measured O(+-600) at emb=128 —
+    # garbage bootstrap targets that dwarf O(1) unit-normalized rewards
+    # and condition the whole early loss landscape (the config-2 collapse
+    # driver). With the gate, q_tot = out_gate * y with out_gate a scalar
+    # param init 0: targets start at exactly the reward signal and the
+    # value scale GROWS from data (gradient dL/d_gate = y*dL/dq_tot is
+    # large, so the gate opens in a few steps).
+    zero_init_gate: bool = False
 
     def pos_func(self, x: jax.Array) -> jax.Array:
         return qmix_pos_func(x, self.qmix_pos_func, self.qmix_pos_func_beta)
@@ -106,6 +117,8 @@ class TransformerMixer(nn.Module):
 
         hidden = nn.elu(jnp.matmul(qvals, w1) + b1)            # (b, 1, emb)
         y = jnp.matmul(hidden, w2) + b2                        # (b, 1, 1)
+        if self.zero_init_gate:
+            y = y * self.param("out_gate", nn.initializers.zeros, (1,))
         return y, out[:, -3:, :]
 
     def initial_hyper(self, batch_size: int) -> jax.Array:

@@ -403,12 +403,15 @@ def fold_mixer_params(variables: dict, *, emb: int, heads: int, depth: int,
         return variables
     p = variables["params"]
     head_dim = emb // heads if standard_heads else emb
-    return {FOLDED: True,
+    tree = {FOLDED: True,
             "fe": p["feat_embedding"],
             "tf": fold_transformer(p["transformer"], emb=emb, heads=heads,
                                    head_dim=head_dim, depth=depth,
                                    dtype=dtype),
             "hb": p["hyper_b2"]}
+    if "out_gate" in p:        # zero_init_gate configs (models/mixer.py)
+        tree["og"] = p["out_gate"]
+    return tree
 
 
 def mixer_forward_qslice(variables: dict, qvals: jnp.ndarray,
@@ -467,4 +470,6 @@ def mixer_forward_qslice(variables: dict, qvals: jnp.ndarray,
 
     hidden = jax.nn.elu(jnp.matmul(qvals.astype(jnp.float32), w1) + b1)
     y = jnp.matmul(hidden, w2) + b2                             # (b, 1, 1)
+    if "og" in f:              # zero_init_gate configs (models/mixer.py)
+        y = y * f["og"].astype(jnp.float32)
     return y, out[:, -3:, :]

@@ -139,4 +139,6 @@ def mixer_apply_sp(mixer: TransformerMixer, variables, qvals: jnp.ndarray,
                      0.0).reshape(b, 1, 1)
     hidden = jax.nn.elu(jnp.matmul(qvals, w1) + b1)
     y = jnp.matmul(hidden, w2) + b2
+    if "out_gate" in p:        # zero_init_gate configs (models/mixer.py)
+        y = y * p["out_gate"]
     return y, out[:, -3:, :]

@@ -57,6 +57,11 @@ class TrainState:
 class Experiment:
     """Built components + jitted programs for one config."""
 
+    # class-level (not a field): whether any build() in this process has
+    # pinned jax_default_prng_impl yet — a later build that CHANGES the
+    # impl is the hazardous case worth a RuntimeWarning
+    _prng_impl_pinned = False
+
     cfg: TrainConfig
     env: object
     mac: object
@@ -70,15 +75,30 @@ class Experiment:
         cfg = sanity_check(cfg)
         # process-global by necessity: raw PRNGKey arrays carry no impl
         # tag, so every split/draw in the jitted programs resolves the
-        # impl from this config. Set unconditionally — a prior build in
-        # the same process may have switched it. "rbg" = XLA
-        # RngBitGenerator, the TPU hardware generator — much cheaper than
-        # threefry for the rollout's many small draws. Key shapes differ
-        # (4 vs 2 uint32), so checkpoints are impl-specific
-        # (shape-validated restore names the mismatch).
-        jax.config.update("jax_default_prng_impl",
-                          {"threefry": "threefry2x32"}.get(cfg.prng_impl,
-                                                           cfg.prng_impl))
+        # impl from this config. "rbg" = XLA RngBitGenerator, the TPU
+        # hardware generator — much cheaper than threefry for the
+        # rollout's many small draws. Key shapes differ (4 vs 2 uint32),
+        # so checkpoints are impl-specific (shape-validated restore names
+        # the mismatch). Only touched when the value actually changes, and
+        # a mid-process switch warns loudly: keys made or programs traced
+        # under the previous impl (an earlier Experiment build in this
+        # process, caller-created keys) mis-resolve under the new one —
+        # interleave cross-impl Experiments at your own risk.
+        want = {"threefry": "threefry2x32"}.get(cfg.prng_impl, cfg.prng_impl)
+        have = jax.config.jax_default_prng_impl
+        if have != want:
+            if cls._prng_impl_pinned:
+                import warnings
+                warnings.warn(
+                    f"Experiment.build switches jax_default_prng_impl "
+                    f"{have!r} -> {want!r} mid-process: PRNG keys and "
+                    f"jitted programs from earlier builds in this process "
+                    f"resolve against the NEW impl and will break or "
+                    f"silently draw different streams; rebuild (or avoid "
+                    f"holding) anything created under the old impl",
+                    RuntimeWarning, stacklevel=2)
+            jax.config.update("jax_default_prng_impl", want)
+        cls._prng_impl_pinned = True
         env = make_env(cfg.env_args)
         env_info = env.get_env_info()
         mac = MAC_REGISTRY[cfg.mac].build(cfg, env_info)

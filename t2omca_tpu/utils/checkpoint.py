@@ -48,8 +48,39 @@ def save_checkpoint(path: str, t_env: int, state: Any) -> str:
     """Write ``<path>/<t_env>/state.msgpack`` + a ``meta.json`` sidecar
     recording the format version and replay obs layout, so a restore with
     a mismatched ``replay.compact_entity_store`` fails with the exact flag
-    to toggle instead of a deep msgpack structure error."""
+    to toggle instead of a deep msgpack structure error.
+
+    Multi-host (``jax.process_count() > 1``): leaves sharded over the
+    global mesh are not host-addressable, so every process joins a
+    ``process_allgather`` (a collective — ALL processes must call this
+    function in lockstep) to assemble them, and only process 0 writes the
+    file. Replicated leaves (params, optimizer — already host-local) skip
+    the gather entirely; only data-sharded leaves (the replay ring,
+    runner lanes) ride the collective. The checkpoint on disk is always
+    the complete global state, restorable on any topology (exact-resume
+    re-shards; model-only fallback via ``load_learner_state``). Known
+    cost at production ring sizes: the allgather materializes the ring on
+    EVERY host (~GiBs over DCN); a per-shard on-disk format (one file per
+    process, orbax-style) is the escape hatch if that ever dominates."""
     d = os.path.join(path, str(int(t_env)))
+    if jax.process_count() > 1:
+        import numpy as _np
+        from jax.experimental import multihost_utils
+
+        def _host_local(x):
+            if not isinstance(x, jax.Array):
+                return x
+            if x.is_fully_addressable:
+                return jax.device_get(x)
+            if x.is_fully_replicated:
+                return _np.asarray(x)      # local shard already holds it
+            return multihost_utils.process_allgather(x, tiled=True)
+
+        # branch choice depends only on shardings — identical on every
+        # process, so the collectives stay in lockstep
+        state = jax.tree.map(_host_local, state)
+        if jax.process_index() != 0:
+            return d
     os.makedirs(d, exist_ok=True)
     with open(os.path.join(d, "state.msgpack"), "wb") as f:
         f.write(serialization.to_bytes(jax.device_get(state)))
@@ -104,15 +135,21 @@ def load_checkpoint(dirname: str, target: Any) -> Any:
     with open(os.path.join(dirname, "state.msgpack"), "rb") as f:
         data = f.read()
     try:
-        if meta is not None and meta.get("format", 0) < 3:
+        if meta is None or meta.get("format", 0) < 3:
             # v2 → v3 migration: v3 added RunnerState.rscale. No v2 run
             # could have had reward_scaling on (the field did not exist),
             # so injecting the template's fresh (all-zero) reward-scale
             # state-dict is lossless — replay contents, normalizer stats,
-            # and RNG state all restore exactly.
+            # and RNG state all restore exactly. Meta-less checkpoints
+            # (pre-v2, before the sidecar existed — or a deleted sidecar)
+            # take the same path: injection is conditional on the field
+            # actually being absent, so a v3 tree without its meta.json
+            # still restores unmodified.
             raw = serialization.msgpack_restore(data)
-            raw["runner"]["rscale"] = serialization.to_state_dict(
-                jax.device_get(target.runner.rscale))
+            if (isinstance(raw, dict) and "runner" in raw
+                    and "rscale" not in raw["runner"]):
+                raw["runner"]["rscale"] = serialization.to_state_dict(
+                    jax.device_get(target.runner.rscale))
             restored = serialization.from_state_dict(target, raw)
         else:
             restored = serialization.from_bytes(target, data)

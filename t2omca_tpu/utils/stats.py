@@ -43,11 +43,20 @@ class StatsAccumulator:
     loop on the slowest link; deferring it lets dispatch run ahead between
     log cadences. Aggregation semantics are unchanged."""
 
+    #: fold threshold: each un-fetched RolloutStats ref pins its device
+    #: buffers alive, so when ``runner_log_interval`` spans many rollouts
+    #: ``_pending`` would grow without bound; past this many pushes the
+    #: partial results are folded into host-side sums (one extra fetch per
+    #: FOLD_EVERY rollouts — negligible against the interval it bounds)
+    FOLD_EVERY = 64
+
     def __init__(self):
         self.n_episodes = 0
         self._pending = []          # un-fetched RolloutStats device refs
         self._eps_ref = None        # epsilon pushed since the last fetch
         self._eps_val = 0.0         # cached host value
+        self._returns: List[float] = []   # folded per-episode returns
+        self._stats = defaultdict(float)  # folded terminal-info sums
 
     def push(self, rollout_stats) -> None:
         self._pending.append(rollout_stats)
@@ -55,13 +64,35 @@ class StatsAccumulator:
         # episode count is static shape info — reading it syncs nothing
         self.n_episodes += int(
             np.prod(rollout_stats.episode_return.shape) or 1)
+        if len(self._pending) >= self.FOLD_EVERY:
+            self._fold()
+
+    def _fold(self) -> None:
+        """Fetch every pending device ref (ONE host round-trip) and fold
+        it into the host-side sums; clears ``_pending``."""
+        if not self._pending:
+            return
+        fetched = jax.device_get(self._pending)
+        for s in fetched:
+            ret = np.atleast_1d(np.asarray(s.episode_return))
+            self._returns.extend(float(x) for x in ret)
+            for k in TERMINAL_INFO_KEYS:
+                self._stats[k] += float(np.sum(getattr(s, k)))
+        # the last pending entry owns the epsilon ref — same fetch
+        self._eps_val = float(np.mean(np.asarray(fetched[-1].epsilon)))
+        self._eps_ref = None
+        self._pending.clear()
 
     @property
     def epsilon(self) -> float:
         """Exploration rate of the most recent rollout (reference logs it
         alongside each train-stat flush, ``parallel_runner.py:217-218``).
-        ``flush`` refreshes the cached value inside its own fetch; a
-        standalone read only syncs when pushes happened since."""
+
+        NOTE: when pushes happened since the last fetch, reading this
+        property performs a BLOCKING device→host fetch (~0.66 s per read
+        under the axon tunnel) — treat mid-interval reads as costly.
+        ``flush`` refreshes the cached value inside its own single fetch,
+        which is where cadenced callers should get it."""
         if self._eps_ref is not None:
             self._eps_val = float(np.mean(np.asarray(
                 jax.device_get(self._eps_ref))))
@@ -71,23 +102,13 @@ class StatsAccumulator:
     def flush(self, logger, t_env: int, prefix: str = "") -> None:
         """Log ``return_mean`` + every ``<k>_mean`` and clear
         (``/root/reference/parallel_runner.py:222-231``)."""
-        fetched = jax.device_get(self._pending)   # ONE host round-trip
-        returns: List[float] = []
-        stats = defaultdict(float)
-        for s in fetched:
-            ret = np.atleast_1d(np.asarray(s.episode_return))
-            returns.extend(float(x) for x in ret)
-            for k in TERMINAL_INFO_KEYS:
-                stats[k] += float(np.sum(getattr(s, k)))
-        if fetched:
-            # the last pending entry owns the epsilon ref — same fetch
-            self._eps_val = float(np.mean(np.asarray(fetched[-1].epsilon)))
-            self._eps_ref = None
-        if returns:
+        self._fold()                              # ONE host round-trip
+        if self._returns:
             logger.log_stat(prefix + "return_mean",
-                            float(np.mean(returns)), t_env)
+                            float(np.mean(self._returns)), t_env)
         n = max(self.n_episodes, 1)
-        for k, v in stats.items():
+        for k, v in self._stats.items():
             logger.log_stat(prefix + k + "_mean", v / n, t_env)
-        self._pending.clear()
+        self._returns.clear()
+        self._stats.clear()
         self.n_episodes = 0

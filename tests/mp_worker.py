@@ -7,24 +7,60 @@ env vars (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/
 production (wired at ``t2omca_tpu/__main__.py``). Each process owns 4
 virtual CPU devices; the global mesh spans both processes, so the data
 axis crosses the process boundary and every collective in the train step
-takes the DCN leg (gloo on CPU; ICI/DCN on a real pod)."""
+takes the DCN leg (gloo on CPU; ICI/DCN on a real pod).
 
+With ``MP_CKPT_DIR`` set, the worker additionally saves a full-state
+checkpoint from the 2-process mesh (the gather-to-process-0 path in
+``utils.checkpoint.save_checkpoint``) and prints a deterministic greedy
+evaluation fingerprint of the trained model; the parent then restores
+the checkpoint model-only in a plain single-process build and asserts
+the identical fingerprint (SURVEY.md §5(4) + A8).
+
+The jax config setup lives under ``__main__`` so the parent test process
+can import :func:`worker_config` / :func:`eval_fingerprint` without
+mutating its own already-initialized backend.
+"""
+
+import os
 import sys
 
-import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
-# CPU cross-process collectives backend (jaxlib ships gloo); a TPU pod
-# uses the ICI/DCN fabric instead, so this stays test-side
-jax.config.update("jax_cpu_collectives_implementation", "gloo")
+def worker_config():
+    """The shared tiny config — the parent's single-process restore must
+    build the identical model."""
+    from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,
+                                   TrainConfig, sanity_check)
+    return sanity_check(TrainConfig(
+        batch_size_run=8, batch_size=8,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=4),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1),
+        replay=ReplayConfig(buffer_size=16),
+    ))
+
+
+def eval_fingerprint(exp, agent_params) -> float:
+    """Deterministic greedy-eval metric: mean episode return of one
+    test-mode rollout from a FIXED runner seed, on the default local
+    device (host-local numpy params in, so no mesh/topology leaks into
+    the program — both mp_worker processes and the parent's restored
+    single-process build must produce the identical float on CPU)."""
+    import jax
+    import numpy as np
+
+    params = jax.device_get(agent_params)     # host-local, uncommitted
+    rs = exp.runner.init_state(jax.random.PRNGKey(7))
+    run = jax.jit(exp.runner.run, static_argnames="test_mode")
+    _, _, stats = run(params, rs, test_mode=True)
+    return float(np.mean(np.asarray(
+        jax.device_get(stats.episode_return))))
 
 
 def main() -> int:
+    import jax
     import jax.numpy as jnp
 
-    from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,
-                                   TrainConfig, sanity_check)
     from t2omca_tpu.parallel import (DataParallel, make_mesh,
                                      maybe_initialize_distributed)
     from t2omca_tpu.run import Experiment
@@ -33,14 +69,7 @@ def main() -> int:
     assert jax.device_count() == 8, jax.device_count()
     assert len(jax.local_devices()) == 4
 
-    cfg = sanity_check(TrainConfig(
-        batch_size_run=8, batch_size=8,
-        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
-                           episode_limit=4),
-        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
-                          mixer_heads=2, mixer_depth=1),
-        replay=ReplayConfig(buffer_size=16),
-    ))
+    cfg = worker_config()
     exp = Experiment.build(cfg)
     mesh = make_mesh(8)
     dp = DataParallel(exp, mesh)
@@ -63,8 +92,25 @@ def main() -> int:
     # the parent compares this line across both processes: identical loss
     # proves the gradient psum crossed the process boundary coherently
     print(f"LOSS {loss:.10f}", flush=True)
+
+    ckpt_dir = os.environ.get("MP_CKPT_DIR")
+    if ckpt_dir:
+        from t2omca_tpu.utils.checkpoint import save_checkpoint
+        # collective: both processes must call; process 0 writes
+        save_checkpoint(ckpt_dir, 32, ts)
+        # %.17g round-trips the float64 exactly — the parent asserts
+        # bit-equality against its own single-process restore
+        print(f"EVAL {eval_fingerprint(exp, ts.learner.params['agent']):.17g}",
+              flush=True)
     return 0
 
 
 if __name__ == "__main__":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+    # CPU cross-process collectives backend (jaxlib ships gloo); a TPU pod
+    # uses the ICI/DCN fabric instead, so this stays test-side
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     sys.exit(main())

@@ -120,6 +120,34 @@ def test_hbm_estimator_schema_and_no_device_work():
         "learner_scan_residuals"}
 
 
+def test_prod_hbm_allocates_ring_and_cross_checks_analytic():
+    """--prod-hbm (VERDICT r4 item 4 producer): PRODUCTION-shaped ring
+    (agv 256 / emb 256 / bf16 compact storage) actually allocated on the
+    8-device virtual mesh, insert + train iteration run with it
+    co-resident, and the --hbm analytic cross-checked against real
+    allocated bytes. Reduced --ring/--envs/--steps keep the CI cost
+    bounded; shapes per episode stay production."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_NUM_CPU_DEVICES"] = "8"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--prod-hbm", "--ring", "64",
+         "--envs", "32", "--steps", "2"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "prod_ring_resident_gib"
+    assert rec["value"] > 0
+    assert rec["ring_episodes"] == 64
+    # the analytic model must track the real allocation closely — this
+    # is the bound that makes the --hbm budget trustworthy at config 5
+    assert abs(rec["analytic_delta_pct"]) < 10, rec
+    assert rec["train_loss"] is not None
+    import math
+    assert math.isfinite(rec["train_loss"])
+
+
 def test_dp_bench_path_on_virtual_mesh():
     """The --config 5 (DP=8) bench is the config-5 round-artifact
     producer: run it at reduced shapes on the 8-device virtual CPU mesh

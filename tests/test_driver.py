@@ -187,6 +187,75 @@ def test_v2_checkpoint_migrates_to_v3_exactly(tmp_path):
                for x in jax.tree_util.tree_leaves(restored.runner.rscale))
 
 
+def test_metaless_checkpoint_missing_rscale_migrates(tmp_path):
+    """A pre-v2 checkpoint has no meta.json sidecar at all; it also
+    predates RunnerState.rscale. It must take the same migration path as
+    a marked v2 file — fresh rscale injected, everything else exact —
+    instead of surfacing the replay-layout ValueError (ADVICE r4)."""
+    from flax import serialization
+    from t2omca_tpu.utils.checkpoint import save_checkpoint
+
+    cfg = tiny_cfg(tmp_path)
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(0)
+    d = save_checkpoint(str(tmp_path / "ckpt"), 40, ts)
+
+    # doctor into pre-v2: strip runner.rscale AND remove the sidecar
+    with open(os.path.join(d, "state.msgpack"), "rb") as f:
+        raw = serialization.msgpack_restore(f.read())
+    del raw["runner"]["rscale"]
+    with open(os.path.join(d, "state.msgpack"), "wb") as f:
+        f.write(serialization.msgpack_serialize(raw))
+    os.remove(os.path.join(d, "meta.json"))
+
+    restored = load_checkpoint(d, exp.init_train_state(3))
+    for (kp, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(jax.device_get(ts)),
+            jax.tree_util.tree_leaves_with_path(jax.device_get(restored))):
+        if ".rscale" in jax.tree_util.keystr(kp):
+            continue
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=jax.tree_util.keystr(kp))
+    assert all(float(np.asarray(x).sum()) == 0.0
+               for x in jax.tree_util.tree_leaves(restored.runner.rscale))
+
+
+def test_metaless_v3_checkpoint_restores_unmodified(tmp_path):
+    """A v3 tree whose meta.json was deleted must restore exactly — the
+    migration's rscale injection is conditional on the field being
+    absent, not on the sidecar's presence."""
+    from t2omca_tpu.utils.checkpoint import save_checkpoint
+
+    cfg = tiny_cfg(tmp_path)
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(0)
+    d = save_checkpoint(str(tmp_path / "ckpt"), 40, ts)
+    os.remove(os.path.join(d, "meta.json"))
+
+    restored = load_checkpoint(d, exp.init_train_state(3))
+    for (kp, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(jax.device_get(ts)),
+            jax.tree_util.tree_leaves_with_path(jax.device_get(restored))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=jax.tree_util.keystr(kp))
+
+
+def test_prng_impl_switch_mid_process_warns(tmp_path):
+    """Experiment.build pins the process-global PRNG impl; a later build
+    that CHANGES it must warn (keys/programs from earlier builds would
+    mis-resolve, ADVICE r4) and an identical re-build must not."""
+    Experiment.build(tiny_cfg(tmp_path))            # pins threefry
+    with pytest.warns(RuntimeWarning, match="mid-process"):
+        Experiment.build(tiny_cfg(tmp_path, prng_impl="rbg"))
+    # switch back quietly restores the default for the rest of the suite
+    with pytest.warns(RuntimeWarning, match="mid-process"):
+        Experiment.build(tiny_cfg(tmp_path))
+    import warnings as _warnings
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")             # same impl: no warning
+        Experiment.build(tiny_cfg(tmp_path))
+
+
 def test_chained_programs_compile_exactly_once(tmp_path):
     """The driver loop feeds every program output back in as an input; a
     weak_type or placement drift in ANY chained leaf (e.g. a

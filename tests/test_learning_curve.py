@@ -35,7 +35,11 @@ def _series(key, root=None, run_glob="qmix*"):
     # best committed seeds — the gate covers more than one seed
     (os.path.join(RUNS, "config1_stable"), "qmix*seed0*"),
     (os.path.join(RUNS, "config1_stable"), "qmix*seed3*"),
-], ids=["dense", "qslice", "faststack", "stable-s0", "stable-s3"])
+    # round-5 loss-scale recipe (reward_unit + huber + mixer_zero_init):
+    # learning preserved under the conditioning fix
+    (os.path.join(RUNS, "config1_recipe"), "qmix*seed0*"),
+], ids=["dense", "qslice", "faststack", "stable-s0", "stable-s3",
+        "recipe-s0"])
 def test_final_test_return_beats_random_baseline(root, run_glob):
     """One gate, three committed artifacts: the last-3-eval mean must beat
     the measured random baseline by > 2σ of its spread."""

@@ -73,6 +73,26 @@ def test_accumulator_sums_terminal_infos_across_rollouts():
     assert acc.n_episodes == 0
 
 
+def test_accumulator_folds_pending_at_cap():
+    """_pending must stay bounded when runner_log_interval spans many
+    rollouts (ADVICE r4): past FOLD_EVERY pushes the refs are folded to
+    host sums, with flush semantics unchanged across fold boundaries."""
+    acc = StatsAccumulator()
+    n = StatsAccumulator.FOLD_EVERY + 5
+    for i in range(n):
+        acc.push(FakeStats(episode_return=np.array([float(i)]),
+                           epsilon=np.array(i / n),
+                           reward=np.array([2.0 * i])))
+        assert len(acc._pending) < StatsAccumulator.FOLD_EVERY
+    assert acc.n_episodes == n
+    log = RecordingLogger()
+    acc.flush(log, t_env=100)
+    assert log.last("return_mean") == np.mean(np.arange(n, dtype=float))
+    assert log.last("reward_mean") == 2.0 * np.mean(np.arange(n))
+    assert acc.epsilon == (n - 1) / n
+    assert acc.n_episodes == 0 and not acc._pending
+
+
 def test_accumulator_epsilon_tracks_last_push():
     acc = StatsAccumulator()
     acc.push(FakeStats(episode_return=np.array([0.0]),

@@ -123,17 +123,20 @@ def test_ring_attention_kv_mask_matches_dense(mesh):
                                np.asarray(dense), atol=1e-5, rtol=1e-5)
 
 
-def test_sp_mixer_matches_dense_mixer(mesh):
+@pytest.mark.parametrize("gate", [False, True])
+def test_sp_mixer_matches_dense_mixer(mesh, gate):
     """mixer_apply_sp (token axis sharded over 8 devices, ring attention)
     must reproduce TransformerMixer.apply exactly — the config-5 consumer
-    of the SP layer (SURVEY.md §2.2 extension point)."""
+    of the SP layer (SURVEY.md §2.2 extension point). Parametrized over
+    zero_init_gate so the SP readout honors the gate param when present
+    (gate value perturbed off its 0-init below to make the check real)."""
     from t2omca_tpu.models.mixer import TransformerMixer
     from t2omca_tpu.parallel.sp_mixer import mixer_apply_sp
 
     a, n_ent, feat, emb = 5, 5, 8, 16
     mixer = TransformerMixer(n_agents=a, n_entities=n_ent, feat_dim=feat,
                              emb=emb, heads=2, depth=2,
-                             state_entity_mode=True)
+                             state_entity_mode=True, zero_init_gate=gate)
     b = 3
     ks = jax.random.split(jax.random.PRNGKey(4), 6)
     qvals = jax.random.normal(ks[0], (b, 1, a))
@@ -142,6 +145,9 @@ def test_sp_mixer_matches_dense_mixer(mesh):
     states = jax.random.normal(ks[3], (b, n_ent * feat))
     obs = jax.random.normal(ks[4], (b, a, 8))
     params = mixer.init(ks[5], qvals, hidden, hyper, states, obs)
+    if gate:   # open the gate so equality is a non-trivial check
+        params = jax.tree.map(lambda x: x, params)
+        params["params"]["out_gate"] = jnp.full((1,), 0.7)
 
     y_dense, hyp_dense = mixer.apply(params, qvals, hidden, hyper, states,
                                      obs)
