@@ -1,5 +1,4 @@
 from .distributed import maybe_initialize_distributed
-from .mesh import (DataParallel, make_mesh, replicate, shard_episode_axis)
+from .mesh import DataParallel, make_mesh
 
-__all__ = ["make_mesh", "replicate", "shard_episode_axis", "DataParallel",
-           "maybe_initialize_distributed"]
+__all__ = ["make_mesh", "DataParallel", "maybe_initialize_distributed"]

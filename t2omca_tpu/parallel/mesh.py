@@ -46,18 +46,6 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(np.asarray(devs).reshape(shape), axis_names)
 
 
-def replicate(tree, mesh: Mesh):
-    """Fully replicate a pytree across the mesh (params, opt state)."""
-    s = NamedSharding(mesh, P())
-    return jax.device_put(tree, s)
-
-
-def shard_episode_axis(tree, mesh: Mesh, axis: str = "data"):
-    """Shard every leaf's leading (episode/env) axis across ``axis``."""
-    s = NamedSharding(mesh, P(axis))
-    return jax.device_put(tree, s)
-
-
 @dataclasses.dataclass(frozen=True)
 class DataParallel:
     """Sharded program wrapper for an ``Experiment`` (``run.Experiment``).
@@ -65,8 +53,12 @@ class DataParallel:
     Usage::
 
         dp = DataParallel(exp, make_mesh(8))
-        ts = dp.shard(exp.init_train_state(seed))
+        ts = dp.init_sharded(seed)          # fresh state, born sharded
         rollout, insert, train_iter = dp.jitted_programs()
+
+    (``dp.shard(restored_ts)`` places an EXISTING state — the resume
+    path; for fresh states prefer ``init_sharded``, which never holds a
+    single-device copy of the replay ring.)
 
     The jitted programs are the experiment's own pure functions; sharding
     comes entirely from the placement of their inputs (GSPMD propagates it),
@@ -85,36 +77,53 @@ class DataParallel:
 
     # ------------------------------------------------------------------ state
 
-    def shard(self, ts):
-        """Place a TrainState: learner replicated, env lanes and replay
-        episodes sharded over the data axis."""
-        env_sharded = shard_episode_axis(ts.runner.env_states, self.mesh,
-                                         self.axis)
-        # reward-scale state is per-lane except the scalar Welford count
+    def state_shardings(self, ts_like):
+        """NamedSharding pytree for a TrainState (or its
+        ``jax.eval_shape`` struct): learner replicated, env lanes and
+        replay episodes sharded over the data axis. Single source of the
+        placement rules — consumed by ``shard`` (device_put of an
+        existing state) and ``init_sharded`` (jit out_shardings, so big
+        states are BORN sharded)."""
         lane = NamedSharding(self.mesh, P(self.axis))
         rep = NamedSharding(self.mesh, P())
-        rscale = jax.tree.map(
-            lambda x: jax.device_put(x, lane if x.ndim else rep),
-            ts.runner.rscale)
-        runner = ts.runner.replace(
-            env_states=env_sharded,
-            key=replicate(ts.runner.key, self.mesh),
-            t_env=replicate(ts.runner.t_env, self.mesh),
-            rscale=rscale)
-        storage = shard_episode_axis(ts.buffer.storage, self.mesh, self.axis)
-        buffer = ts.buffer.replace(
-            storage=storage,
-            insert_pos=replicate(ts.buffer.insert_pos, self.mesh),
-            episodes_in_buffer=replicate(ts.buffer.episodes_in_buffer,
-                                         self.mesh),
-            priorities=replicate(ts.buffer.priorities, self.mesh),
-            max_priority=replicate(ts.buffer.max_priority, self.mesh))
-        return ts.replace(
-            learner=replicate(ts.learner, self.mesh),
-            runner=runner,
-            buffer=buffer,
-            episode=replicate(ts.episode, self.mesh),
-        )
+
+        def fill(subtree, s):
+            return jax.tree.map(lambda _: s, subtree)
+
+        runner = ts_like.runner.replace(
+            env_states=fill(ts_like.runner.env_states, lane),
+            key=rep, t_env=rep,
+            # reward-scale state is per-lane except the scalar Welford count
+            rscale=jax.tree.map(
+                lambda x: lane if getattr(x, "ndim", 0) else rep,
+                ts_like.runner.rscale))
+        buffer = ts_like.buffer.replace(
+            storage=fill(ts_like.buffer.storage, lane),
+            insert_pos=rep, episodes_in_buffer=rep,
+            priorities=rep, max_priority=rep)
+        return ts_like.replace(
+            learner=fill(ts_like.learner, rep),
+            runner=runner, buffer=buffer, episode=rep)
+
+    def shard(self, ts):
+        """Place an existing TrainState onto the mesh (host→device copy;
+        peak = old + new. For states whose replay ring is a large share
+        of host/device memory prefer ``init_sharded``)."""
+        return jax.device_put(ts, self.state_shardings(ts))
+
+    def init_sharded(self, seed: int):
+        """Build the initial TrainState DIRECTLY under the mesh sharding:
+        jit with out_shardings means XLA materializes each leaf (notably
+        the replay ring's zeros) as per-device shards only — no
+        full-state single-device transient, which at config-5 ring sizes
+        (~59 GiB bf16) is the difference between fitting and OOM at
+        startup. Equivalent to ``shard(exp.init_train_state(seed))`` up
+        to jit-fusion float reassociation in the env-reset math (measured
+        rel ~1e-8 on 3 env-state leaves; params bit-identical)."""
+        shapes = jax.eval_shape(lambda: self.exp.init_train_state(seed))
+        return jax.jit(
+            lambda: self.exp.init_train_state(seed),
+            out_shardings=self.state_shardings(shapes))()
 
     # ------------------------------------------------------------------ programs
 

@@ -273,7 +273,6 @@ def run_sequential(exp: Experiment, logger: Logger,
     env_info = exp.env.get_env_info()
     log.info(f"env_info: {env_info}")
 
-    ts = exp.init_train_state(cfg.seed)
     # ---- data parallelism (SURVEY.md §7.2(6)) --------------------------
     # dp_devices > 0 swaps in the mesh-sharded program triple; the loop
     # below is identical either way (same pure functions, GSPMD shardings
@@ -284,6 +283,21 @@ def run_sequential(exp: Experiment, logger: Logger,
         dp = DataParallel(exp, make_mesh(cfg.dp_devices))
         log.info(f"data-parallel over {cfg.dp_devices} devices "
                  f"(mesh axis 'data')")
+    # resolve the resume target FIRST: a checkpoint_path pointing at an
+    # empty directory (the enable-resume-from-day-one pattern) is still a
+    # fresh start and must take the born-sharded init below
+    found = None
+    if cfg.checkpoint_path:
+        found = find_checkpoint(cfg.checkpoint_path, cfg.load_step)
+        if found is None:
+            log.info(f"no checkpoint found in {cfg.checkpoint_path}")
+    if dp is not None and found is None:
+        # fresh DP start: build the state BORN sharded (out_shardings) —
+        # the single-device-then-reshard path holds a full extra copy of
+        # the replay ring at startup, an OOM at config-5 ring sizes
+        ts = dp.init_sharded(cfg.seed)
+    else:
+        ts = exp.init_train_state(cfg.seed)
     # the driver loop replaces its state right after every call, so the
     # replay ring / train state can be donated (in-place on device)
     rollout, insert, train_iter = (dp or exp).jitted_programs(donate=True)
@@ -291,20 +305,17 @@ def run_sequential(exp: Experiment, logger: Logger,
 
     t_env = 0
     # ---- resume (reference :159-189, Q13: t_env cursor restored) ----
-    if cfg.checkpoint_path:
-        found = find_checkpoint(cfg.checkpoint_path, cfg.load_step)
-        if found is None:
-            log.info(f"no checkpoint found in {cfg.checkpoint_path}")
-        else:
-            dirname, step = found
-            ts = load_checkpoint(dirname, ts)
-            t_env = step
-            ts = ts.replace(runner=ts.runner.replace(
-                t_env=jnp.asarray(step, jnp.int32)))
-            log.info(f"resumed from {dirname} at t_env={step}")
-    if dp is not None:
-        # place/re-place the (possibly restored) state on the mesh: params
-        # replicated, env lanes + replay episodes sharded on the data axis
+    if found is not None:
+        dirname, step = found
+        ts = load_checkpoint(dirname, ts)
+        t_env = step
+        ts = ts.replace(runner=ts.runner.replace(
+            t_env=jnp.asarray(step, jnp.int32)))
+        log.info(f"resumed from {dirname} at t_env={step}")
+    if dp is not None and found is not None:
+        # place the restored state on the mesh: params replicated, env
+        # lanes + replay episodes sharded on the data axis (fresh starts
+        # were born sharded above)
         ts = dp.shard(ts)
 
     model_dir = os.path.join(cfg.local_results_path, "models",

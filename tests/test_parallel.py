@@ -46,6 +46,29 @@ def test_divisibility_guard():
         DataParallel(exp, make_mesh(8))
 
 
+def test_init_sharded_equals_shard_of_init(dp_setup):
+    """dp.init_sharded builds the state BORN sharded (jit out_shardings —
+    no single-device full-ring transient at startup); it must be
+    value-identical and placement-identical to shard(init_train_state)."""
+    cfg, exp, dp, ts = dp_setup
+    ts2 = dp.init_sharded(0)
+    for (kp, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(ts),
+            jax.tree_util.tree_leaves_with_path(ts2)):
+        k = jax.tree_util.keystr(kp)
+        assert a.sharding == b.sharding, (k, a.sharding, b.sharding)
+        if "learner" in k:     # params/optimizer must be bit-identical
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=k)
+        else:
+            # env-reset math under jit fuses differently: rel ~1e-8
+            # reassociation on a few env-state leaves (init_sharded doc)
+            np.testing.assert_allclose(
+                np.asarray(a).astype(np.float64),
+                np.asarray(b).astype(np.float64),
+                rtol=1e-6, atol=1e-3, err_msg=k)
+
+
 def test_sharded_rollout_and_train_step(dp_setup):
     cfg, exp, dp, ts = dp_setup
     rollout, insert, train_iter = dp.jitted_programs()
