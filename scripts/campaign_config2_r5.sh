@@ -26,11 +26,16 @@
 # (runs/config1_recipe/SUMMARY.md).
 #
 # Usage: nohup scripts/campaign_config2_r5.sh [outdir] [seeds...] &
+#   T2OMCA_CAMPAIGN_EXTRA="action_selector=noisy-new"  adds an arm's
+#   extra key=value overrides (the reference agent ships NoisyLinear and
+#   its runner guards for non-epsilon selectors — per-agent noise is the
+#   reference-faithful symmetry breaker for the 16-agent joint argmax).
 set -u
 cd "$(dirname "$0")/.."
 OUT=${1:-/tmp/config2_r5}
 shift || true
 SEEDS=${@:-0 1 2}
+EXTRA=${T2OMCA_CAMPAIGN_EXTRA:-}
 mkdir -p "$OUT"
 for s in $SEEDS; do
   echo "[campaign] seed $s start $(date -u +%FT%TZ)" >> "$OUT/campaign.log"
@@ -43,6 +48,7 @@ for s in $SEEDS; do
     model.mixer_zero_init=true \
     seed=$s save_model=false log_interval=2000 \
     local_results_path="$OUT/seed$s" \
+    $EXTRA \
     >> "$OUT/seed${s}.log" 2>&1
   echo "[campaign] seed $s done rc=$? $(date -u +%FT%TZ)" >> "$OUT/campaign.log"
 done

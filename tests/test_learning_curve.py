@@ -38,8 +38,10 @@ def _series(key, root=None, run_glob="qmix*"):
     # round-5 loss-scale recipe (reward_unit + huber + mixer_zero_init):
     # learning preserved under the conditioning fix
     (os.path.join(RUNS, "config1_recipe"), "qmix*seed0*"),
+    # recipe + NoisyNet (the 16-AGV campaign's arm-B selector)
+    (os.path.join(RUNS, "config1_noisy"), "qmix*seed0*"),
 ], ids=["dense", "qslice", "faststack", "stable-s0", "stable-s3",
-        "recipe-s0"])
+        "recipe-s0", "noisy-s0"])
 def test_final_test_return_beats_random_baseline(root, run_glob):
     """One gate, three committed artifacts: the last-3-eval mean must beat
     the measured random baseline by > 2σ of its spread."""
