@@ -88,9 +88,10 @@ class ModelConfig:
     use_pallas: bool = False              # fused-kernel acting path (rollout forwards)
     pallas_tile: int = 16                 # sequences per kernel grid step (VMEM-bounded)
     # exact token-0-only agent forward (ops/query_slice.py): on by default,
-    # auto-disabled where inapplicable (non-transformer agent, dropout>0,
-    # noisy selector); an explicit use_pallas=True takes precedence on the
-    # acting path
+    # auto-disabled where inapplicable (non-transformer agent, dropout>0);
+    # noisy selectors STAY eligible — the noise is q-head-only, sampled
+    # post-slice from an explicit key (round 5). An explicit
+    # use_pallas=True takes precedence on the acting path
     use_qslice: bool = True
     # entity-table acting (ops/query_slice.agent_forward_qslice_entity):
     # contract attention against per-env (A, E) tables instead of

@@ -140,21 +140,35 @@ class BasicMAC:
             standard_heads=a.standard_heads, dtype=a.dtype,
             interpret=self.pallas_interpret, tile=self.pallas_tile)
 
-    def forward_qslice(self, params, obs: jnp.ndarray, hidden: jnp.ndarray
+    def _noise_key(self, key, deterministic: bool):
+        """Noise key for the qslice/entity q-head: only noisy agents in
+        non-deterministic (train rollout / learner) mode sample noise —
+        mirroring ``TransformerAgent``'s eval-mode mu path."""
+        if key is None or deterministic or not self.agent.noisy:
+            return None
+        return key
+
+    def forward_qslice(self, params, obs: jnp.ndarray, hidden: jnp.ndarray,
+                       key: jax.Array | None = None,
+                       deterministic: bool = True
                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Exact token-0-only forward over the same param tree
         (ops/query_slice). Plain jnp, differentiable — also used by the
-        learner's deterministic unrolls. ``params`` may be the raw tree or
-        a ``prepare_acting_params`` result."""
+        learner's deterministic AND noisy unrolls (the noise lives only in
+        the q-head). ``params`` may be the raw tree or a
+        ``prepare_acting_params`` result."""
         from ..ops.query_slice import agent_forward_qslice
         a = self.agent
         return agent_forward_qslice(
             params, obs, hidden,
             n_entities=a.n_entities, feat_dim=a.feat_dim, emb=a.emb,
             heads=a.heads, depth=a.depth, n_actions=a.n_actions,
-            standard_heads=a.standard_heads, dtype=a.dtype)
+            standard_heads=a.standard_heads, dtype=a.dtype,
+            noise_key=self._noise_key(key, deterministic))
 
-    def forward_entity(self, params, compact, hidden: jnp.ndarray
+    def forward_entity(self, params, compact, hidden: jnp.ndarray,
+                       key: jax.Array | None = None,
+                       deterministic: bool = True
                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Entity-table forward (ops/query_slice): ``compact`` is the
         ``env.compact_obs`` tuple, batched over envs."""
@@ -164,7 +178,8 @@ class BasicMAC:
         return agent_forward_qslice_entity(
             params, rows, same_mec, mean, std, hidden,
             emb=a.emb, heads=a.heads, depth=a.depth, n_actions=a.n_actions,
-            standard_heads=a.standard_heads, dtype=a.dtype)
+            standard_heads=a.standard_heads, dtype=a.dtype,
+            noise_key=self._noise_key(key, deterministic))
 
     def prepare_acting_params(self, params):
         """Pre-fold the qslice projection products ONCE, outside any scan
@@ -191,11 +206,15 @@ class BasicMAC:
         entity-table forward when the MAC was built eligible."""
         k_noise, k_sel = jax.random.split(key)
         if self.use_entity_tables and compact is not None:
-            q, hidden = self.forward_entity(params, compact, hidden)
+            q, hidden = self.forward_entity(params, compact, hidden,
+                                            key=k_noise,
+                                            deterministic=test_mode)
         elif self.use_pallas:
             q, hidden = self.forward_fast(params, obs, hidden)
         elif self.use_qslice:
-            q, hidden = self.forward_qslice(params, obs, hidden)
+            q, hidden = self.forward_qslice(params, obs, hidden,
+                                            key=k_noise,
+                                            deterministic=test_mode)
         else:
             q, hidden = self.forward(params, obs, hidden, key=k_noise,
                                      deterministic=test_mode)

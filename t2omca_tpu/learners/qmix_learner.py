@@ -156,6 +156,13 @@ class QMixLearner:
         return (self.cfg.action_selector == "noisy-new"
                 or self.cfg.model.dropout > 0.0)
 
+    def _fold_params(self, agent_params):
+        from ..ops.query_slice import fold_agent_params
+        a = self.mac.agent
+        return fold_agent_params(
+            agent_params, emb=a.emb, heads=a.heads, depth=a.depth,
+            standard_heads=a.standard_heads, dtype=a.dtype)
+
     def _unroll_agent(self, agent_params, obs_tm: jnp.ndarray,
                       key: Optional[jax.Array] = None,
                       compact_tm=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -165,22 +172,36 @@ class QMixLearner:
         noise resampling, matching a fresh draw per forward. With
         ``compact_tm`` (time-major ``(rows, same_mec, mean, std)`` from
         compact entity storage) the unroll runs the entity-table forward —
-        same function, ~20× less input data (obs_tm may be None)."""
+        same function, ~20× less input data (obs_tm may be None).
+
+        Fast-path coverage: qslice/entity unrolls serve the deterministic
+        AND the noisy configs (noise is q-head-only, applied per step from
+        the split keys — ops/query_slice._q_head); only dropout>0 falls
+        back to the dense flax unroll."""
         if compact_tm is not None:
-            assert key is None   # compact storage gated to the pure path
             b = compact_tm[0].shape[1]
-            from ..ops.query_slice import fold_agent_params
-            a = self.mac.agent
-            agent_params = fold_agent_params(
-                agent_params, emb=a.emb, heads=a.heads, depth=a.depth,
-                standard_heads=a.standard_heads, dtype=a.dtype)
+            agent_params = self._fold_params(agent_params)
 
-            def body(h, xs):
-                q, h = self.mac.forward_entity(agent_params, xs, h)
-                return h, (q, h)
+            if key is None:
+                def body(h, xs):
+                    q, h = self.mac.forward_entity(agent_params, xs, h)
+                    return h, (q, h)
 
-            _, (qs, hs) = jax.lax.scan(
-                self._scan_body(body), self.mac.init_hidden(b), compact_tm)
+                _, (qs, hs) = jax.lax.scan(
+                    self._scan_body(body), self.mac.init_hidden(b),
+                    compact_tm)
+            else:
+                def body(h, xs):
+                    compact_t, k_t = xs
+                    q, h = self.mac.forward_entity(
+                        agent_params, compact_t, h, key=k_t,
+                        deterministic=False)
+                    return h, (q, h)
+
+                keys = jax.random.split(key, compact_tm[0].shape[0])
+                _, (qs, hs) = jax.lax.scan(
+                    self._scan_body(body), self.mac.init_hidden(b),
+                    (compact_tm, keys))
             return qs, hs
 
         b = obs_tm.shape[1]
@@ -192,11 +213,7 @@ class QMixLearner:
             # whenever eligible; the weight fold happens here, outside the
             # scan (differentiable, loop-invariant)
             if self._agent_qslice:
-                from ..ops.query_slice import fold_agent_params
-                a = self.mac.agent
-                agent_params = fold_agent_params(
-                    agent_params, emb=a.emb, heads=a.heads, depth=a.depth,
-                    standard_heads=a.standard_heads, dtype=a.dtype)
+                agent_params = self._fold_params(agent_params)
                 fwd = self.mac.forward_qslice
             else:
                 fwd = self.mac.forward
@@ -208,11 +225,23 @@ class QMixLearner:
             _, (qs, hs) = jax.lax.scan(self._scan_body(body),
                                        self.mac.init_hidden(b), obs_tm)
         else:
-            def body(h, xs):
-                obs_t, k_t = xs
-                q, h = self.mac.forward(agent_params, obs_t, h,
-                                        key=k_t, deterministic=False)
-                return h, (q, h)
+            if self._agent_qslice:
+                # noisy config on the fast path: sliced stack + per-step
+                # noise keys into the q-head
+                agent_params = self._fold_params(agent_params)
+
+                def body(h, xs):
+                    obs_t, k_t = xs
+                    q, h = self.mac.forward_qslice(
+                        agent_params, obs_t, h, key=k_t,
+                        deterministic=False)
+                    return h, (q, h)
+            else:
+                def body(h, xs):
+                    obs_t, k_t = xs
+                    q, h = self.mac.forward(agent_params, obs_t, h,
+                                            key=k_t, deterministic=False)
+                    return h, (q, h)
 
             keys = jax.random.split(key, obs_tm.shape[0])
             _, (qs, hs) = jax.lax.scan(
@@ -294,6 +323,12 @@ class QMixLearner:
 
         if key is not None:
             k_ag, k_tag, k_mx, k_tmx = jax.random.split(key, 4)
+            if cfg.model.dropout == 0.0:
+                # noisy-only configs: the mixer has no noise source
+                # (NoisyLinear lives in the agent q-head only), so its
+                # unroll stays on the deterministic fast path — passing
+                # keys here forced the dense flax mixer scan for nothing
+                k_mx = k_tmx = None
         else:
             k_ag = k_tag = k_mx = k_tmx = None
 

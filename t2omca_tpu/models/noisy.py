@@ -25,6 +25,20 @@ def _scaled_noise(key: jax.Array, n: int) -> jax.Array:
     return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
 
 
+def noisy_weights(w_mu, w_sigma, b_mu, b_sigma, key):
+    """ONE factored-Gaussian draw of the noisy affine — the single source
+    of the construction, shared by the flax module below (key from
+    ``make_rng("noise")``) and the qslice q-head
+    (``ops/query_slice._q_head``, explicit key). ``b_mu=None`` for
+    bias-less layers."""
+    k_in, k_out = jax.random.split(key)
+    eps_in = _scaled_noise(k_in, w_mu.shape[0])
+    eps_out = _scaled_noise(k_out, w_mu.shape[1])
+    w = w_mu + w_sigma * jnp.outer(eps_in, eps_out)
+    b = None if b_mu is None else b_mu + b_sigma * eps_out
+    return w, b
+
+
 class NoisyLinear(nn.Module):
     features: int
     use_bias: bool = True
@@ -49,12 +63,11 @@ class NoisyLinear(nn.Module):
             w = w_mu
             b = b_mu if self.use_bias else None
         else:
-            key = self.make_rng("noise")
-            k_in, k_out = jax.random.split(key)
-            eps_in = _scaled_noise(k_in, in_dim)
-            eps_out = _scaled_noise(k_out, self.features)
-            w = w_mu + w_sigma * jnp.outer(eps_in, eps_out)
-            b = (b_mu + b_sigma * eps_out) if self.use_bias else None
+            w, b = noisy_weights(
+                w_mu, w_sigma,
+                b_mu if self.use_bias else None,
+                b_sigma if self.use_bias else None,
+                self.make_rng("noise"))
 
         y = x @ w
         if b is not None:

@@ -67,15 +67,19 @@ FOLDED = "__qslice_folded__"
 
 
 def agent_qslice_eligible(cfg) -> bool:
-    """Single source of truth for agent-side eligibility: the reduction is
-    exact only for the deterministic transformer forward (no dropout mask
-    to sample, no NoisyLinear q-head). Consumers: ``BasicMAC.build`` (which
-    additionally lets an explicit ``use_pallas`` own the acting path) and
-    ``QMixLearner`` (which ignores ``use_pallas`` — the kernel has no VJP)."""
+    """Single source of truth for agent-side eligibility: the reduction
+    needs a deterministic transformer STACK (no dropout mask inside the
+    blocks). NoisyLinear is fine: the noise lives only in the q-head
+    (``models/agent.py:64-66``), which applies AFTER the sliced stack —
+    ``_q_head`` samples it from an explicit key (round 5; previously
+    noisy configs were excluded wholesale, which forced the reference's
+    own selector onto the dense path). Consumers: ``BasicMAC.build``
+    (which additionally lets an explicit ``use_pallas`` own the acting
+    path) and ``QMixLearner`` (which ignores ``use_pallas`` — the kernel
+    has no VJP)."""
     return (cfg.model.use_qslice
             and cfg.agent == "transformer"
-            and cfg.model.dropout == 0.0
-            and cfg.action_selector != "noisy-new")
+            and cfg.model.dropout == 0.0)
 
 
 def entity_tables_eligible(cfg) -> bool:
@@ -221,6 +225,35 @@ def _block_tail(bp: dict, attended: jnp.ndarray, x0_flat: jnp.ndarray,
     return x2.astype(dtype)
 
 
+def _q_head(qb: dict, h_new: jnp.ndarray,
+            noise_key: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Apply the Q head to ``(S, E)`` f32 hidden rows. ``qb`` is either
+    the dense ``q_basic`` params ({kernel, bias}) or NoisyLinear params
+    ({w_mu, w_sigma, b_mu, b_sigma} — ``models/noisy.py``).
+
+    ``noise_key=None`` is the deterministic path (mu weights — exactly
+    NoisyLinear's eval mode, so test-mode equivalence with the dense
+    module is bit-for-reassociation). With a key, ONE factored-Gaussian
+    draw perturbs the weights for the whole call — the dense module's
+    one-draw-per-forward semantics (all agents share the draw; per-agent
+    diversity comes through each agent's h). The raw key is split here
+    (in/out factors) rather than run through flax's path-folded
+    ``make_rng``, so the NOISE STREAM differs from the flax module's for
+    the same key — identical distribution, different sample; documented
+    in docs/SPEC.md §7 (use_qslice row)."""
+    if "kernel" in qb:
+        return (jnp.dot(h_new, qb["kernel"].astype(jnp.float32))
+                + qb["bias"].astype(jnp.float32))
+    w = qb["w_mu"].astype(jnp.float32)
+    b = qb["b_mu"].astype(jnp.float32)
+    if noise_key is not None:
+        from ..models.noisy import noisy_weights
+        w, b = noisy_weights(w, qb["w_sigma"].astype(jnp.float32),
+                             b, qb["b_sigma"].astype(jnp.float32),
+                             noise_key)
+    return jnp.dot(h_new, w) + b
+
+
 def fold_agent_params(variables: dict, *, emb: int, heads: int, depth: int,
                       standard_heads: bool = False, dtype=jnp.float32
                       ) -> dict:
@@ -244,9 +277,11 @@ def agent_forward_qslice(variables: dict, inputs: jnp.ndarray,
                          n_entities: int, feat_dim: int, emb: int,
                          heads: int, depth: int, n_actions: int,
                          standard_heads: bool = False,
-                         dtype=jnp.float32
+                         dtype=jnp.float32,
+                         noise_key: jnp.ndarray | None = None
                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Drop-in for ``TransformerAgent.apply`` (non-noisy, dropout=0):
+    """Drop-in for ``TransformerAgent.apply`` (dropout=0; noisy heads
+    supported via ``noise_key`` — see ``_q_head``):
     inputs ``(B, A, obs)``, hidden ``(B, A, emb)`` → (q, hidden').
     Accepts either the raw flax variables or a ``fold_agent_params`` tree."""
     f = fold_agent_params(variables, emb=emb, heads=heads, depth=depth,
@@ -269,9 +304,7 @@ def agent_forward_qslice(variables: dict, inputs: jnp.ndarray,
                            dtype=dtype)                         # (S, 1, E)
 
     h_new = out[:, 0, :]                                        # (S, E) f32
-    qb = f["qb"]
-    q = (jnp.dot(h_new, qb["kernel"].astype(jnp.float32))
-         + qb["bias"].astype(jnp.float32))
+    q = _q_head(f["qb"], h_new, noise_key)
     return (q.reshape(b, a, n_actions),
             h_new.reshape(b, a, emb))
 
@@ -299,10 +332,12 @@ def agent_forward_qslice_entity(variables: dict, rows: jnp.ndarray,
                                 std: jnp.ndarray, hidden_state: jnp.ndarray,
                                 *, emb: int, heads: int, depth: int,
                                 n_actions: int, standard_heads: bool = False,
-                                dtype=jnp.float32
+                                dtype=jnp.float32,
+                                noise_key: jnp.ndarray | None = None
                                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Entity-table acting forward: ``agent_forward_qslice`` without ever
-    materializing per-agent token embeddings.
+    materializing per-agent token embeddings. ``noise_key`` as in
+    ``_q_head`` (noisy heads supported).
 
     Exploits the structure of the entity observation
     (``envs/mec_offload.py:_raw_obs`` + the shared ``fast_norm`` affine):
@@ -387,9 +422,7 @@ def agent_forward_qslice_entity(variables: dict, rows: jnp.ndarray,
             .reshape(b, a, emb)
 
     h_new = x0.astype(jnp.float32).reshape(s, emb)
-    qb = f["qb"]
-    q = (jnp.dot(h_new, qb["kernel"].astype(jnp.float32))
-         + qb["bias"].astype(jnp.float32))
+    q = _q_head(f["qb"], h_new, noise_key)
     return (q.reshape(b, a, n_actions),
             h_new.reshape(b, a, emb))
 

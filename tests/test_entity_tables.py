@@ -179,6 +179,59 @@ def test_compact_store_train_matches_full_store():
                                rtol=1e-3, atol=1e-4)
 
 
+def test_noisy_entity_path_noise_and_sigma_gradients():
+    """The 16-agent campaign's arm-B training branch: a noisy config with
+    the default fast stack routes acting AND the compact-storage learner
+    unroll through ``forward_entity`` with noise keys. Pin (a) the key
+    actually reaches the q-head (q perturbs off the mu path; same key →
+    same draw; hidden stream untouched) and (b) sigma params receive
+    gradient through the full compact-storage loss."""
+    cfg = _cfg()
+    cfg = cfg.replace(
+        action_selector="noisy-new", batch_size=4,
+        replay=dataclasses.replace(cfg.replay, buffer_size=8,
+                                   prioritized=True))
+    cfg = sanity_check(cfg)
+    exp = Experiment.build(cfg)
+    env, mac = exp.env, exp.mac
+    assert mac.use_entity_tables and mac.agent.noisy
+
+    b = cfg.batch_size_run
+    key = jax.random.PRNGKey(0)
+    states, _obs = _rolled_states(env, b, 3, key)
+    compact = jax.vmap(env.compact_obs)(states)
+    params = mac.init_params(key, env.obs_dim)
+    hidden = jnp.zeros((b, env.n_agents, cfg.model.emb))
+
+    q_mu, h_mu = mac.forward_entity(params, compact, hidden)
+    q_n, h_n = mac.forward_entity(params, compact, hidden,
+                                  key=jax.random.PRNGKey(5),
+                                  deterministic=False)
+    q_n2, _ = mac.forward_entity(params, compact, hidden,
+                                 key=jax.random.PRNGKey(5),
+                                 deterministic=False)
+    np.testing.assert_array_equal(np.asarray(h_n), np.asarray(h_mu))
+    np.testing.assert_array_equal(np.asarray(q_n), np.asarray(q_n2))
+    assert not np.allclose(np.asarray(q_n), np.asarray(q_mu))
+
+    # (b) full loss through the CompactEntityObs unroll
+    from t2omca_tpu.components.episode_buffer import CompactEntityObs
+    ts = exp.init_train_state(0)
+    rollout, insert, _ = exp.jitted_programs()
+    rs, batch, _ = rollout(ts.learner.params["agent"], ts.runner,
+                           test_mode=False)
+    bstate = insert(ts.buffer, batch)
+    sample, idx, w = exp.buffer.sample(bstate, jax.random.PRNGKey(2),
+                                       cfg.batch_size, 0)
+    assert isinstance(sample.obs, CompactEntityObs)
+    grads, _ = jax.grad(exp.learner._loss, has_aux=True)(
+        ts.learner.params, ts.learner.target_params, sample, w,
+        jax.random.PRNGKey(7))
+    qg = grads["agent"]["params"]["q_basic"]
+    for name in ("w_sigma", "b_sigma"):
+        assert np.abs(np.asarray(qg[name])).max() > 0, name
+
+
 def test_compact_store_driver_e2e(tmp_path):
     """Full run() through compact storage: trains, checkpoints (the buffer
     pytree now nests CompactEntityObs), resumes."""

@@ -103,6 +103,95 @@ def test_gradients_match_dense():
     np.testing.assert_allclose(flat_q, flat_d, rtol=1e-3, atol=1e-4)
 
 
+def _build_noisy(seed=3):
+    agent = TransformerAgent(
+        n_agents=3, n_entities=5, feat_dim=9, emb=32, heads=2, depth=2,
+        n_actions=4, noisy=True)
+    k = jax.random.PRNGKey(seed)
+    kp, ko, kh = jax.random.split(k, 3)
+    b = 4
+    obs = jax.random.normal(ko, (b, 3, 5 * 9))
+    hidden = jax.random.normal(kh, (b, 3, 32))
+    params = agent.init(kp, obs, hidden)
+    return agent, params, obs, hidden
+
+
+def test_noisy_eval_mode_matches_dense():
+    """Noisy agents are qslice-eligible (round 5: the noise is q-head-only)
+    — in deterministic/eval mode both paths use the mu weights and must
+    agree like any other config."""
+    agent, params, obs, hidden = _build_noisy()
+    q_ref, h_ref = agent.apply(params, obs, hidden)   # deterministic=True
+    q_qs, h_qs = _qslice(agent, params, obs, hidden)  # noise_key=None
+    np.testing.assert_allclose(q_qs, q_ref, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(h_qs, h_ref, rtol=2e-4, atol=2e-5)
+
+
+def test_noisy_qslice_noise_semantics():
+    """With a noise key the qslice head perturbs Q (one factored-Gaussian
+    draw per call, shared across the batch like the dense module) and
+    leaves the hidden stream untouched; same key → same sample."""
+    from t2omca_tpu.ops.query_slice import agent_forward_qslice
+
+    agent, params, obs, hidden = _build_noisy()
+
+    def fwd(key):
+        return agent_forward_qslice(
+            params, obs, hidden, n_entities=5, feat_dim=9, emb=32,
+            heads=2, depth=2, n_actions=4, noise_key=key)
+
+    q_mu, h_mu = fwd(None)
+    q_a, h_a = fwd(jax.random.PRNGKey(11))
+    q_a2, _ = fwd(jax.random.PRNGKey(11))
+    q_b, _ = fwd(jax.random.PRNGKey(12))
+    np.testing.assert_array_equal(np.asarray(h_a), np.asarray(h_mu))
+    np.testing.assert_array_equal(np.asarray(q_a), np.asarray(q_a2))
+    assert not np.allclose(q_a, q_mu)
+    assert not np.allclose(q_a, q_b)
+    assert np.isfinite(np.asarray(q_a)).all()
+
+
+def test_noisy_gradients_flow_to_sigma_through_qslice():
+    """The learner unrolls noisy configs through the qslice forward —
+    sigma params must receive gradient through it."""
+    from t2omca_tpu.ops.query_slice import agent_forward_qslice
+
+    agent, params, obs, hidden = _build_noisy()
+
+    def loss(p):
+        q, h = agent_forward_qslice(
+            p, obs, hidden, n_entities=5, feat_dim=9, emb=32, heads=2,
+            depth=2, n_actions=4, noise_key=jax.random.PRNGKey(5))
+        return (q ** 2).sum()
+
+    g = jax.grad(loss)(params)["params"]["q_basic"]
+    for name in ("w_mu", "w_sigma", "b_mu", "b_sigma"):
+        assert np.abs(np.asarray(g[name])).max() > 0, name
+
+
+def test_noisy_config_is_fast_path_eligible():
+    """The reference's own selector must resolve to the full fast stack
+    (the round-5 enabler for the 16-agent campaign's arm B)."""
+    from t2omca_tpu.ops.query_slice import (agent_qslice_eligible,
+                                            entity_store_eligible)
+    cfg = sanity_check(TrainConfig(action_selector="noisy-new"))
+    assert agent_qslice_eligible(cfg)
+    assert entity_store_eligible(cfg)
+    mac = _noisy_mac(cfg)
+    assert mac.use_qslice and mac.use_entity_tables
+    # dropout still excludes the stack reduction
+    cfg2 = sanity_check(TrainConfig(
+        action_selector="noisy-new",
+        model=ModelConfig(dropout=0.1)))
+    assert not agent_qslice_eligible(cfg2)
+
+
+def _noisy_mac(cfg):
+    from t2omca_tpu.envs.registry import make_env
+    env = make_env(cfg.env_args)
+    return BasicMAC.build(cfg, env.get_env_info())
+
+
 @pytest.mark.parametrize("state_entity_mode", [True, False])
 @pytest.mark.parametrize("pos_func", ["abs", "softplus"])
 def test_mixer_forward_matches_dense(state_entity_mode, pos_func):
@@ -236,9 +325,10 @@ def test_mac_build_resolves_eligibility():
     cfg_do = cfg.replace(model=dataclasses.replace(cfg.model, dropout=0.1))
     assert not BasicMAC.build(cfg_do, env_info).use_qslice
 
-    # noisy selector → dense fallback (NoisyLinear q-head)
+    # noisy selector stays on the fast path (round 5: noise is q-head-only
+    # — the sliced stack is deterministic, the head samples from a key)
     cfg_noisy = cfg.replace(action_selector="noisy-new")
-    assert not BasicMAC.build(cfg_noisy, env_info).use_qslice
+    assert BasicMAC.build(cfg_noisy, env_info).use_qslice
 
     # rnn agent → dense fallback
     cfg_rnn = cfg.replace(agent="rnn", mixer="vdn")
