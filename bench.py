@@ -142,8 +142,7 @@ def breakdown(cfg, exp, ts, _time, args) -> int:
         print(f"# cost_analysis unavailable: {e!r}", file=sys.stderr)
 
     env_steps = b * t_len
-    acting_mode = ("pallas" if cfg.model.use_pallas
-                   else "entity" if mac.use_entity_tables
+    acting_mode = ("entity" if mac.use_entity_tables
                    else "qslice" if mac.use_qslice else "dense")
     print(f"# breakdown at {b} envs x {t_len} slots "
           f"({cfg.env_args.agv_num} AGVs, d{cfg.model.emb}, "
@@ -655,15 +654,14 @@ def bench_all(make_cfg, _time, _pipe_rate, args) -> int:
         print(f"# config-4 train failed: {e!r}", file=sys.stderr)
     gc.collect()
 
-    # 3. acting-path comparison at config 3 (the Pallas-fate data,
-    #    VERDICT r3 task 8)
-    for label in ("pallas", "dense"):
-        try:
-            emit(rollout_rate(make_cfg(label, 3), label,
-                              {"config": cid(3)}))
-        except Exception as e:              # pragma: no cover - defensive
-            print(f"# {label} rollout failed: {e!r}", file=sys.stderr)
-        gc.collect()
+    # 3. acting-path comparison at config 3 (dense = the XLA full
+    #    forward; the Pallas kernel was deleted in round 5 — BASELINE.md)
+    try:
+        emit(rollout_rate(make_cfg("dense", 3), "dense",
+                          {"config": cid(3)}))
+    except Exception as e:                  # pragma: no cover - defensive
+        print(f"# dense rollout failed: {e!r}", file=sys.stderr)
+    gc.collect()
 
     # 3b. PRNG-impl comparison at config 3: leg 1 is the threefry
     #     baseline; rbg routes every draw through the TPU hardware bit
@@ -711,15 +709,12 @@ def main() -> int:
     ap.add_argument("--profile", default="",
                     help="capture a jax.profiler trace of the timed "
                          "iterations into this directory")
-    ap.add_argument("--acting", choices=("qslice", "pallas", "dense"),
+    ap.add_argument("--acting", choices=("qslice", "dense"),
                     default="qslice",
                     help="agent forward for the rollout: qslice (exact "
                          "token-0-only reduction, ops/query_slice — the "
-                         "default), pallas (fused-block kernel), dense "
-                         "(XLA full forward; reproduces the BASELINE.md "
-                         "XLA-path row)")
-    ap.add_argument("--no-pallas", action="store_true",
-                    help="deprecated alias for --acting dense")
+                         "default) or dense (XLA full forward; reproduces "
+                         "the BASELINE.md XLA-path row)")
     ap.add_argument("--no-fast-norm", action="store_true",
                     help="sequential per-agent Welford (reference-exact "
                          "normalizer ordering) instead of the batched merge")
@@ -732,7 +727,7 @@ def main() -> int:
                          "rollout+train loop (BASELINE.json config 4)")
     ap.add_argument("--all", action="store_true",
                     help="comprehensive single-process sweep: default "
-                         "rollout+train line, breakdown, pallas/dense "
+                         "rollout+train line, breakdown, qslice/dense "
                          "comparison, threefry/rbg comparison, config-4 "
                          "scale — one backend init, one JSON line per "
                          "measurement (tunnel-scarce mode)")
@@ -763,8 +758,6 @@ def main() -> int:
     ap.add_argument("--heads", type=int, default=4,
                     help="agent/mixer head count (d256 standard heads: 4 -> "
                          "head_dim 64, 2 -> head_dim 128 = full MXU lanes)")
-    ap.add_argument("--tile", type=int, default=16,
-                    help="Pallas kernel tile (sequences per grid step)")
     ap.add_argument("--prng", choices=("threefry", "rbg", "unsafe_rbg"),
                     default="threefry",
                     help="PRNG impl for all keys: rbg = the TPU hardware "
@@ -778,8 +771,6 @@ def main() -> int:
                          "defaults to K=4 on full-scale runs, pass 0 "
                          "to disable")
     args = ap.parse_args()
-    if args.no_pallas:
-        args.acting = "dense"
     if args.pipeline is not None and args.pipeline < 0:
         ap.error("--pipeline K must be >= 0")
     if args.pipeline and (args.hbm or args.breakdown or args.prod_hbm):
@@ -862,7 +853,6 @@ def main() -> int:
                                episode_limit=steps),
             model=ModelConfig(emb=16, heads=2, depth=1, mixer_emb=16,
                               mixer_heads=2, mixer_depth=1,
-                              use_pallas=args.acting == "pallas",
                               use_qslice=args.acting != "dense"),
             replay=ReplayConfig(buffer_size=16),
         ))
@@ -888,14 +878,8 @@ def main() -> int:
                                   mixer_heads=args.heads,
                                   mixer_depth=c["depth"],
                                   standard_heads=True, dtype="bfloat16",
-                                  use_pallas=acting == "pallas",
-                                  # production pallas configs leave qslice
-                                  # on — the learner trains through it
-                                  # regardless of the acting kernel
-                                  # (QMixLearner._agent_qslice)
                                   use_qslice=acting != "dense",
-                                  remat=args.remat,
-                                  pallas_tile=args.tile),
+                                  remat=args.remat),
                 replay=ReplayConfig(buffer_size=4, store_dtype="bfloat16"),
             ))
 
@@ -975,7 +959,7 @@ def main() -> int:
             # turn the leg-1 headline into rbg with no threefry baseline)
             raise SystemExit(
                 "--all runs its own fixed measurement set (config-3 "
-                "headline + config-4 train + pallas/dense + "
+                "headline + config-4 train + qslice/dense + "
                 "threefry/rbg + breakdown); drop "
                 "--config/--acting/--train/--breakdown/--prng")
         with tracing():

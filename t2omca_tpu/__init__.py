@@ -18,7 +18,7 @@ Package map:
   runners/      vmapped rollout runner + single-env episode runner
   replay/       episode batch pytree + uniform & prioritized replay (device-resident)
   parallel/     mesh construction, sharded train step, ring attention (SP extension)
-  ops/          pallas kernels (opt-in fused attention)
+  ops/          hot-path op reductions (query-slice / entity tables)
   utils/        logging, time helpers, schedules, checkpointing
 """
 

@@ -85,13 +85,10 @@ class ModelConfig:
     use_orthogonal: bool = False
     standard_heads: bool = False          # perf mode: per-head dim = emb//heads (quirk Q1 off)
     dtype: str = "float32"                # compute dtype: float32 | bfloat16 (perf mode)
-    use_pallas: bool = False              # fused-kernel acting path (rollout forwards)
-    pallas_tile: int = 16                 # sequences per kernel grid step (VMEM-bounded)
     # exact token-0-only agent forward (ops/query_slice.py): on by default,
     # auto-disabled where inapplicable (non-transformer agent, dropout>0);
     # noisy selectors STAY eligible — the noise is q-head-only, sampled
-    # post-slice from an explicit key (round 5). An explicit
-    # use_pallas=True takes precedence on the acting path
+    # post-slice from an explicit key (round 5)
     use_qslice: bool = True
     # entity-table acting (ops/query_slice.agent_forward_qslice_entity):
     # contract attention against per-env (A, E) tables instead of
@@ -282,11 +279,6 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
                 f"emb={cfg.model.emb}/heads={cfg.model.heads}, "
                 f"mixer_emb={cfg.model.mixer_emb}/mixer_heads={cfg.model.mixer_heads}."
             )
-    if cfg.model.use_pallas and (cfg.model.dropout != 0.0
-                                 or cfg.action_selector == "noisy-new"):
-        raise ValueError(
-            "use_pallas supports only dropout=0 and non-noisy agents "
-            "(the fused acting kernel has no dropout/noise path)")
     # valid family names; mirrored from controllers.AGENT_REGISTRY /
     # learners.MIXER_REGISTRY (config cannot import them — circular) and
     # pinned by tests/test_model_families.py
@@ -298,10 +290,6 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
     if cfg.mixer not in _mixers:
         raise ValueError(f"unknown mixer '{cfg.mixer}'; valid: "
                          f"{sorted(_mixers)}")
-    if cfg.model.use_pallas and cfg.agent != "transformer":
-        raise ValueError(
-            "use_pallas is the fused transformer acting path; "
-            f"agent='{cfg.agent}' has no Pallas kernel")
     if (cfg.model.dropout > 0.0 and cfg.agent != "transformer"
             and cfg.mixer != "transformer"):
         # transformer modules implement dropout; with neither family

@@ -38,9 +38,6 @@ class BasicMAC:
     n_agents: int
     n_actions: int
     emb: int
-    use_pallas: bool = False    # fused-kernel acting path (ops/fast_agent)
-    pallas_interpret: bool = False
-    pallas_tile: int = 16
     use_qslice: bool = False    # exact token-0-only forward (ops/query_slice)
     use_entity_tables: bool = False   # table-contracted entity acting
 
@@ -53,21 +50,6 @@ class BasicMAC:
             # flat-obs mode / flat-input agents: the whole obs vector is one
             # entity token
             n_entities, feat = 1, env_info["obs_shape"]
-        use_pallas = cfg.model.use_pallas
-        if use_pallas:
-            if (cfg.model.dropout != 0.0
-                    or cfg.action_selector == "noisy-new"
-                    or cfg.agent != "transformer"):
-                # also enforced in config.sanity_check; kept for callers
-                # that build a MAC without going through load_config
-                raise ValueError(
-                    "use_pallas supports only the non-noisy transformer "
-                    "agent with dropout=0")
-            backend = jax.default_backend()
-            if backend not in ("tpu", "cpu"):
-                raise ValueError(
-                    f"use_pallas requires a TPU (or CPU-interpret) backend; "
-                    f"got '{backend}' — unset model.use_pallas")
         agent = AGENT_REGISTRY[cfg.agent](
             n_agents=n_agents,
             n_entities=n_entities + 0,
@@ -86,16 +68,12 @@ class BasicMAC:
         schedule = DecayThenFlatSchedule(
             cfg.epsilon_start, cfg.epsilon_finish, cfg.epsilon_anneal_time)
         selector = SELECTOR_REGISTRY[cfg.action_selector](schedule)
-        # query-slice eligibility (shared predicate, ops/query_slice.py);
-        # an explicit use_pallas request keeps the kernel acting path
+        # query-slice eligibility (shared predicate, ops/query_slice.py)
         from ..ops.query_slice import (agent_qslice_eligible,
                                        entity_tables_eligible)
-        use_qslice = agent_qslice_eligible(cfg) and not use_pallas
+        use_qslice = agent_qslice_eligible(cfg)
         return cls(agent=agent, selector=selector, n_agents=n_agents,
                    n_actions=env_info["n_actions"], emb=cfg.model.emb,
-                   use_pallas=use_pallas,
-                   pallas_interpret=jax.default_backend() == "cpu",
-                   pallas_tile=cfg.model.pallas_tile,
                    use_qslice=use_qslice,
                    use_entity_tables=(use_qslice
                                       and entity_tables_eligible(cfg)))
@@ -126,19 +104,6 @@ class BasicMAC:
             rngs = None
         return self.agent.apply(params, obs, hidden,
                                 deterministic=deterministic, rngs=rngs)
-
-    def forward_fast(self, params, obs: jnp.ndarray, hidden: jnp.ndarray
-                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        """Fused-kernel forward over the same param tree (acting path; no
-        gradient support — the learner differentiates ``forward``)."""
-        from ..ops.fast_agent import agent_forward_fast
-        a = self.agent
-        return agent_forward_fast(
-            params, obs, hidden,
-            n_entities=a.n_entities, feat_dim=a.feat_dim, emb=a.emb,
-            heads=a.heads, depth=a.depth, n_actions=a.n_actions,
-            standard_heads=a.standard_heads, dtype=a.dtype,
-            interpret=self.pallas_interpret, tile=self.pallas_tile)
 
     def _noise_key(self, key, deterministic: bool):
         """Noise key for the qslice/entity q-head: only noisy agents in
@@ -185,7 +150,7 @@ class BasicMAC:
         """Pre-fold the qslice projection products ONCE, outside any scan
         that calls ``select_actions``/``forward_qslice`` in its body (the
         fold is loop-invariant; XLA is not guaranteed to hoist it). No-op
-        on the dense/pallas paths."""
+        on the dense path."""
         if not self.use_qslice:
             return params
         from ..ops.query_slice import fold_agent_params
@@ -209,8 +174,6 @@ class BasicMAC:
             q, hidden = self.forward_entity(params, compact, hidden,
                                             key=k_noise,
                                             deterministic=test_mode)
-        elif self.use_pallas:
-            q, hidden = self.forward_fast(params, obs, hidden)
         elif self.use_qslice:
             q, hidden = self.forward_qslice(params, obs, hidden,
                                             key=k_noise,

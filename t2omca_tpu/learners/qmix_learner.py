@@ -126,10 +126,8 @@ class QMixLearner:
 
     @property
     def _agent_qslice(self) -> bool:
-        """Learner-side qslice eligibility (shared predicate): unlike
-        ``mac.use_qslice`` this ignores ``use_pallas`` — the Pallas kernel
-        owns only the acting path (it has no VJP), so a pallas config still
-        trains on the exact differentiable qslice forward."""
+        """Learner-side qslice eligibility (the shared predicate — same
+        fast path as acting, exact and differentiable)."""
         from ..ops.query_slice import agent_qslice_eligible
         return agent_qslice_eligible(self.cfg)
 

@@ -32,10 +32,9 @@ the learner can unroll through it too).
 
 All ops are fat ``(S, ·)×(·, ·)`` matmuls over the folded batch×agent axis
 plus two bandwidth-bound batched contractions against ``k0`` — no Pallas
-needed; XLA fuses the rest. Matches the numerics conventions of the fused
-kernel (``ops/transformer_block.py``): f32 accumulation, f32 LayerNorm
-statistics, softmax in f32 for the f32 parity mode and bf16 for the perf
-mode (mirroring ``models/transformer.py:101-105``).
+needed; XLA fuses the rest. Numerics conventions: f32 accumulation, f32
+LayerNorm statistics, softmax in f32 for the f32 parity mode and bf16 for
+the perf mode (mirroring ``models/transformer.py:101-105``).
 
 Forward-compatible with gradient flow: everything here is plain jnp, so
 ``jax.grad`` through it yields the same gradients as the dense module (same
@@ -49,7 +48,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-LN_EPS = 1e-6   # flax nn.LayerNorm default, as in ops/transformer_block.py
+LN_EPS = 1e-6   # flax nn.LayerNorm default
 
 
 def _ln(x32: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray
@@ -73,10 +72,8 @@ def agent_qslice_eligible(cfg) -> bool:
     (``models/agent.py:64-66``), which applies AFTER the sliced stack —
     ``_q_head`` samples it from an explicit key (round 5; previously
     noisy configs were excluded wholesale, which forced the reference's
-    own selector onto the dense path). Consumers: ``BasicMAC.build``
-    (which additionally lets an explicit ``use_pallas`` own the acting
-    path) and ``QMixLearner`` (which ignores ``use_pallas`` — the kernel
-    has no VJP)."""
+    own selector onto the dense path). Consumers: ``BasicMAC.build`` and
+    ``QMixLearner`` (both acting and learner unrolls share it)."""
     return (cfg.model.use_qslice
             and cfg.agent == "transformer"
             and cfg.model.dropout == 0.0)
@@ -208,7 +205,7 @@ def transformer_rows(tf_folded: dict, k0: jnp.ndarray, x0: jnp.ndarray, *,
 def _block_tail(bp: dict, attended: jnp.ndarray, x0_flat: jnp.ndarray,
                 dtype) -> jnp.ndarray:
     """Post-attention block tail shared by both query-slice forwards:
-    Q2 post-LN residuals + FFN, f32 statistics (ops/transformer_block.py).
+    Q2 post-LN residuals + FFN, f32 statistics.
     ``attended (N, E)`` f32, ``x0_flat (N, E)`` in compute dtype."""
     x1 = _ln(attended + x0_flat.astype(jnp.float32),
              bp["n1"]["scale"].astype(jnp.float32),

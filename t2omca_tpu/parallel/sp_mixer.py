@@ -17,7 +17,7 @@ Q1/Q2/Q11/Q12 included) with the TOKEN axis sharded across a mesh axis:
 
 The functions read the SAME flax param tree the dense module owns — no
 separate parameters, no checkpoint divergence (same pattern as
-``ops/fast_agent``). Dense-equivalence is asserted on the virtual 8-device
+``ops/query_slice``). Dense-equivalence is asserted on the virtual 8-device
 mesh in ``tests/test_ring_attention.py``.
 """
 

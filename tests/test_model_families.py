@@ -94,12 +94,6 @@ def test_ff_mixer_monotonic_in_agent_qs():
     assert (np.asarray(g) >= 0).all()
 
 
-def test_pallas_rejected_for_rnn_agent():
-    with pytest.raises(ValueError, match="[Pp]allas"):
-        sanity_check(TrainConfig(agent="rnn",
-                                 model=ModelConfig(use_pallas=True)))
-
-
 def test_unknown_family_names_rejected():
     with pytest.raises(ValueError, match="unknown agent"):
         sanity_check(TrainConfig(agent="gru"))

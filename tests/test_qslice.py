@@ -334,12 +334,6 @@ def test_mac_build_resolves_eligibility():
     cfg_rnn = cfg.replace(agent="rnn", mixer="vdn")
     assert not BasicMAC.build(cfg_rnn, env_info).use_qslice
 
-    # explicit pallas request wins over the qslice default
-    cfg_pl = cfg.replace(model=dataclasses.replace(cfg.model,
-                                                   use_pallas=True))
-    mac_pl = BasicMAC.build(cfg_pl, env_info)
-    assert mac_pl.use_pallas and not mac_pl.use_qslice
-
 
 def test_select_actions_matches_dense_greedy():
     """Greedy rollout actions agree between the qslice and dense paths."""
