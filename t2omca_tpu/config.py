@@ -133,6 +133,39 @@ class ReplayConfig:
 
 
 @dataclass(frozen=True)
+class ResilienceConfig:
+    """Fault-tolerance knobs (docs/RESILIENCE.md). All of these govern the
+    driver/checkpoint layer only — the train math is untouched, so every
+    default is safe for parity configs."""
+
+    # SIGTERM/SIGINT → flag → orderly loop exit with one final emergency
+    # checkpoint and a resume hint (utils/resilience.ShutdownGuard). TPU
+    # preemption then loses at most one iteration instead of up to
+    # save_model_interval env steps.
+    handle_signals: bool = True
+    emergency_checkpoint: bool = True
+    # non-finite guard (learners/qmix_learner.py): the jitted train step
+    # skips the parameter+priority update when loss/grads go non-finite
+    # (params pass through unchanged); the driver counts CONSECUTIVE
+    # tripped steps at the log cadence (async pipeline stays unblocked)
+    # and, at this threshold, restores the newest valid checkpoint and
+    # continues. 0 disables the restore escalation (guard still skips).
+    nonfinite_tolerance: int = 3
+    # guard-triggered restores allowed before the run aborts with a
+    # diagnosis (a deterministic NaN source would otherwise loop forever)
+    max_restores: int = 2
+    # checkpoint retention (utils/checkpoint.prune_checkpoints): keep the
+    # newest keep_last steps, plus every step divisible by keep_every
+    # (0 = no modular survivors). keep_last=0 disables pruning entirely.
+    keep_last: int = 0
+    keep_every: int = 0
+    # fault injection (tests/test_resilience.py ONLY): multiply the loss
+    # by NaN at exactly this learner train step (-1 = off). Static config,
+    # so the disabled case costs nothing inside jit.
+    inject_nan_at_step: int = -1
+
+
+@dataclass(frozen=True)
 class TrainConfig:
     """Top-level run flags (reference run-control set, SURVEY.md §5.6)."""
 
@@ -244,6 +277,7 @@ class TrainConfig:
     env_args: EnvConfig = field(default_factory=EnvConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
     replay: ReplayConfig = field(default_factory=ReplayConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
@@ -308,6 +342,23 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
                 "dp_devices shards the device-resident replay ring; "
                 "buffer_cpu_only keeps storage in host RAM — pick one")
         check_dp_divisibility(cfg, cfg.dp_devices)
+    res = cfg.resilience
+    if res.nonfinite_tolerance < 0:
+        raise ValueError(f"resilience.nonfinite_tolerance must be >= 0 "
+                         f"(0 disables the restore escalation), got "
+                         f"{res.nonfinite_tolerance}")
+    if res.max_restores < 0:
+        raise ValueError(f"resilience.max_restores must be >= 0, got "
+                         f"{res.max_restores}")
+    if res.keep_last < 0 or res.keep_every < 0:
+        raise ValueError(
+            f"resilience.keep_last/keep_every must be >= 0, got "
+            f"keep_last={res.keep_last}, keep_every={res.keep_every}")
+    if res.inject_nan_at_step >= 0 and res.nonfinite_tolerance == 0:
+        raise ValueError(
+            "resilience.inject_nan_at_step is a fault-injection knob whose "
+            "point is exercising the restore escalation — enabling it with "
+            "nonfinite_tolerance=0 (escalation off) tests nothing")
     if cfg.mixer == "transformer" and cfg.model.mixer_emb != cfg.model.emb:
         raise ValueError(
             "mixer_emb must equal emb: the transformer mixer concatenates "
@@ -336,11 +387,13 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
     env_kw = dict(updates.pop("env_args", {}) or {})
     model_kw = dict(updates.pop("model", {}) or {})
     replay_kw = dict(updates.pop("replay", {}) or {})
+    resilience_kw = dict(updates.pop("resilience", {}) or {})
 
     # route flat keys to their sub-config for reference-style flat configs
     env_fields = {f.name for f in dataclasses.fields(EnvConfig)}
     model_fields = {f.name for f in dataclasses.fields(ModelConfig)}
     replay_fields = {f.name for f in dataclasses.fields(ReplayConfig)}
+    resilience_fields = {f.name for f in dataclasses.fields(ResilienceConfig)}
     top_fields = {f.name for f in dataclasses.fields(TrainConfig)}
     flat = dict(updates)
     for k, v in flat.items():
@@ -355,6 +408,9 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
         elif k in env_fields:
             env_kw.setdefault(k, v)
             updates.pop(k)
+        elif k in resilience_fields:
+            resilience_kw.setdefault(k, v)
+            updates.pop(k)
         else:
             raise KeyError(f"unknown config key: {k}")
 
@@ -364,6 +420,9 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
         updates["model"] = dataclasses.replace(cfg.model, **model_kw)
     if replay_kw:
         updates["replay"] = dataclasses.replace(cfg.replay, **replay_kw)
+    if resilience_kw:
+        updates["resilience"] = dataclasses.replace(cfg.resilience,
+                                                    **resilience_kw)
     return cfg.replace(**updates)
 
 

@@ -415,7 +415,18 @@ class QMixLearner:
         """One importance-weighted QMIX update; hard target sync every
         ``target_update_interval`` episodes (PyMARL convention, M8).
         ``key`` drives NoisyLinear/dropout sampling and is required when the
-        config uses either (otherwise sigma params get zero gradient)."""
+        config uses either (otherwise sigma params get zero gradient).
+
+        Non-finite guard rail (docs/RESILIENCE.md): ``info["all_finite"]``
+        flags whether loss AND gradients came out finite; when it trips,
+        params and optimizer state pass through UNCHANGED (elementwise
+        select inside jit — no host sync, the async dispatch pipeline
+        stays unblocked) and the driver decides at its log cadence whether
+        the streak warrants a checkpoint restore. ``train_steps`` counts
+        train-step *invocations* (skipped updates included) so fault
+        injection and step-indexed diagnostics stay monotonic across
+        skips. ``isfinite(global_norm)`` covers every grad leaf: one
+        NaN/Inf anywhere poisons the norm."""
         del t_env
         if self.needs_rngs and key is None:
             raise ValueError(
@@ -425,11 +436,34 @@ class QMixLearner:
         if not self.needs_rngs:
             key = None   # identical program for all callers in the pure path
         opt = _make_optimizer(self.cfg)
-        grads, info = jax.grad(self._loss, has_aux=True)(
-            ls.params, ls.target_params, batch, weights, key)
+
+        inject_at = self.cfg.resilience.inject_nan_at_step
+
+        def loss_fn(params):
+            loss, info = self._loss(params, ls.target_params, batch,
+                                    weights, key)
+            if inject_at >= 0:       # fault injection (static: free when off)
+                trip = ls.train_steps == inject_at
+                loss = loss * jnp.where(trip, jnp.float32(jnp.nan),
+                                        jnp.float32(1.0))
+                info = dict(info, loss=loss)
+            return loss, info
+
+        grads, info = jax.grad(loss_fn, has_aux=True)(ls.params)
         info["grad_norm"] = optax.global_norm(grads)
+        all_finite = (jnp.isfinite(info["loss"])
+                      & jnp.isfinite(info["grad_norm"]))
+        info["all_finite"] = all_finite
         updates, opt_state = opt.update(grads, ls.opt_state, ls.params)
         params = optax.apply_updates(ls.params, updates)
+        # guard rail: a tripped step is a no-op on params AND opt state
+        # (a NaN grad corrupts Adam's mu/nu permanently, so opt_state must
+        # pass through too, not just params)
+        params = jax.tree.map(
+            lambda n, o: jnp.where(all_finite, n, o), params, ls.params)
+        opt_state = jax.tree.map(
+            lambda n, o: jnp.where(all_finite, n, o), opt_state,
+            ls.opt_state)
 
         episode = jnp.asarray(episode, jnp.int32)
         sync = (episode - ls.last_target_update

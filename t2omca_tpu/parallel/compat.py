@@ -1,0 +1,28 @@
+"""JAX version compatibility shims for the parallel package.
+
+The installed JAX floor is 0.4.x (the axon image pins 0.4.37), where
+``shard_map`` still lives in ``jax.experimental.shard_map`` and the
+replication-check kwarg is ``check_rep``; newer JAX promotes it to
+``jax.shard_map`` with ``check_vma``. Callers import the one symbol from
+here and always write the NEW spelling (``check_vma``) — the shim
+translates downward so the codebase never forks on version.
+"""
+
+from __future__ import annotations
+
+try:                                    # JAX >= 0.5: public API
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, /, *, mesh, in_specs, out_specs, **kw):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+except ImportError:                     # JAX 0.4.x: experimental API
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, /, *, mesh, in_specs, out_specs, **kw):
+        if "check_vma" in kw:           # renamed from check_rep in 0.5
+            kw["check_rep"] = kw.pop("check_vma")
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+__all__ = ["shard_map"]

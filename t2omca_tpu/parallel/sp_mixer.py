@@ -29,9 +29,9 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
 from ..models.mixer import TransformerMixer
+from .compat import shard_map
 from .ring_attention import ring_attention
 
 LN_EPS = 1e-6   # flax nn.LayerNorm default, matches models/transformer.py

@@ -35,8 +35,9 @@ from .learners.qmix_learner import LEARNER_REGISTRY, LearnerState
 from .runners import RUNNER_REGISTRY
 from .runners.episode_runner import EpisodeRunner
 from .runners.parallel_runner import ParallelRunner, RunnerState
+from .utils import resilience
 from .utils.checkpoint import (find_checkpoint, load_checkpoint,
-                               save_checkpoint)
+                               prune_checkpoints, save_checkpoint)
 from .utils.logging import Logger
 from .utils.profiling import StageTimer, TraceWindow
 from .utils.stats import StatsAccumulator
@@ -215,8 +216,13 @@ class Experiment:
                                                     int(t_env))
                 learner_state, info = train(ts.learner, batch, weights,
                                             t_env, ts.episode, key)
-                buffer.update_priorities(
-                    idx, jax.device_get(info["td_errors_abs"]) + 1e-6)
+                # non-finite guard: the priority fetch below already
+                # blocks (host path is synchronous), so the flag fetch
+                # costs nothing extra; a tripped step leaves the sum-tree
+                # untouched (NaN priorities would corrupt it permanently)
+                td = jax.device_get(info["td_errors_abs"])
+                if bool(jax.device_get(info["all_finite"])):
+                    buffer.update_priorities(idx, td + 1e-6)
                 return ts.replace(learner=learner_state), info
 
             return rollout, insert, train_iter_host
@@ -235,8 +241,15 @@ class Experiment:
             learner_state, info = learner.train(
                 ts.learner, constrain(batch), weights, t_env, ts.episode,
                 k_learn)
-            buf = buffer.update_priorities(
-                ts.buffer, idx, info["td_errors_abs"] + 1e-6)   # Q9
+            # non-finite guard: a tripped step must not scatter NaN
+            # priorities into the ring (they would win every PER draw
+            # forever) — write back the episodes' EXISTING priorities,
+            # value-identical to not updating, with no host sync and no
+            # full-ring select
+            prio = jnp.where(info["all_finite"],
+                             info["td_errors_abs"] + 1e-6,     # Q9
+                             ts.buffer.priorities[idx])
+            buf = buffer.update_priorities(ts.buffer, idx, prio)
             return _strong(ts.replace(learner=c_learner(learner_state),
                                       buffer=c_buffer(buf))), info
 
@@ -307,7 +320,8 @@ def run_sequential(exp: Experiment, logger: Logger,
     # ---- resume (reference :159-189, Q13: t_env cursor restored) ----
     if found is not None:
         dirname, step = found
-        ts = load_checkpoint(dirname, ts)
+        # find_checkpoint already hashed this candidate — skip re-verify
+        ts = load_checkpoint(dirname, ts, verify=False)
         t_env = step
         ts = ts.replace(runner=ts.runner.replace(
             t_env=jnp.asarray(step, jnp.int32)))
@@ -320,6 +334,16 @@ def run_sequential(exp: Experiment, logger: Logger,
 
     model_dir = os.path.join(cfg.local_results_path, "models",
                              os.path.basename(results_dir))
+
+    # ---- resilience (docs/RESILIENCE.md) -------------------------------
+    res = cfg.resilience
+    # SIGTERM/SIGINT → flag; the loop polls it once per iteration and
+    # performs the orderly exit below (emergency checkpoint + exit 0)
+    guard = (resilience.ShutdownGuard.install() if res.handle_signals
+             else resilience.ShutdownGuard())
+    nonfinite_streak = 0            # consecutive tripped train steps
+    nonfinite_total = 0
+    restores = 0                    # guard-triggered checkpoint restores
 
     last_test_t = t_env - cfg.test_interval - 1
     last_log_t = t_env
@@ -366,120 +390,211 @@ def run_sequential(exp: Experiment, logger: Logger,
     buffer_capacity = 0 if exp.host_buffer else exp.buffer.capacity
     inflight = deque()              # rollout outputs not yet waited on
 
-    while t_env <= cfg.t_max:
-        tracer.maybe_start(t_env)
-        # ---------------- rollout (no grad by construction) ----------------
-        with timer.stage("rollout"):
-            rs, batch, stats = rollout(ts.learner.params["agent"], ts.runner,
-                                       test_mode=False)
-            ts = ts.replace(runner=rs,
-                            buffer=insert(ts.buffer, batch),
-                            episode=ts.episode + cfg.batch_size_run)
-            if sync_stages:
-                jax.block_until_ready(rs.t_env)
-        t_env += steps_per_rollout
-        episode += cfg.batch_size_run
-        buffer_filled = min(buffer_filled + cfg.batch_size_run,
-                            buffer_capacity)
-        train_acc.push(stats)
-        # bound the dispatch run-ahead: block on the rollout from two
-        # iterations back (TPU executes in dispatch order, so this caps
-        # live episode batches at ~3 while still double-buffering
-        # host↔device)
-        inflight.append(stats.epsilon)
-        if len(inflight) > 2:
-            jax.block_until_ready(inflight.popleft())
-
-        # ---------------- train gate (reference :220-238) ------------------
-        if exp.host_buffer:
-            can = exp.buffer.can_sample(cfg.batch_size)
-        else:
-            can = buffer_filled >= cfg.batch_size
-        if can and episode >= cfg.accumulated_episodes:
-            key, k_sample = jax.random.split(key)
-            with timer.stage("train"):
-                ts, info = train_iter(ts, k_sample, jnp.asarray(t_env))
+    # signal handlers are process-global state: restore them on
+    # EVERY exit (normal, preemption, divergence abort)
+    try:
+        while t_env <= cfg.t_max:
+            # fault-injection hook + preemption poll (docs/RESILIENCE.md):
+            # the signal handler only sets a flag; the orderly exit —
+            # emergency checkpoint, resume hint, exit 0 — happens here, at an
+            # iteration boundary where ts is a complete consistent state
+            resilience.fire("driver.iteration", t_env=t_env, guard=guard)
+            if guard.triggered:
+                break
+            tracer.maybe_start(t_env)
+            # ---------------- rollout (no grad by construction) ----------------
+            with timer.stage("rollout"):
+                rs, batch, stats = rollout(ts.learner.params["agent"], ts.runner,
+                                           test_mode=False)
+                ts = ts.replace(runner=rs,
+                                buffer=insert(ts.buffer, batch),
+                                episode=ts.episode + cfg.batch_size_run)
                 if sync_stages:
-                    jax.block_until_ready(info["loss"])
-            train_infos.append(info)
-        tracer.tick(logger)
+                    jax.block_until_ready(rs.t_env)
+            t_env += steps_per_rollout
+            episode += cfg.batch_size_run
+            buffer_filled = min(buffer_filled + cfg.batch_size_run,
+                                buffer_capacity)
+            train_acc.push(stats)
+            # bound the dispatch run-ahead: block on the rollout from two
+            # iterations back (TPU executes in dispatch order, so this caps
+            # live episode batches at ~3 while still double-buffering
+            # host↔device)
+            inflight.append(stats.epsilon)
+            if len(inflight) > 2:
+                jax.block_until_ready(inflight.popleft())
 
-        # train-stat cadence: runner_log_interval, epsilon alongside
-        # (reference parallel_runner.py:215-219). Deliberately after the
-        # train dispatch: at configs where B·T ≥ the interval this flush
-        # fires every iteration, and its blocking stat fetch then overlaps
-        # the already-enqueued train step instead of serializing it.
-        if t_env - last_runner_log_t >= cfg.runner_log_interval:
-            train_acc.flush(logger, t_env)
-            logger.log_stat("epsilon", train_acc.epsilon, t_env)
-            last_runner_log_t = t_env
+            # ---------------- train gate (reference :220-238) ------------------
+            if exp.host_buffer:
+                can = exp.buffer.can_sample(cfg.batch_size)
+            else:
+                can = buffer_filled >= cfg.batch_size
+            if can and episode >= cfg.accumulated_episodes:
+                key, k_sample = jax.random.split(key)
+                with timer.stage("train"):
+                    ts, info = train_iter(ts, k_sample, jnp.asarray(t_env))
+                    if sync_stages:
+                        jax.block_until_ready(info["loss"])
+                train_infos.append(info)
+            tracer.tick(logger)
 
-        # ---------------- test cadence (reference :240-256) ----------------
-        if (t_env - last_test_t) / cfg.test_interval >= 1.0:
-            log.info(f"t_env: {t_env} / {cfg.t_max}")
-            log.info(
-                f"Estimated time left: "
-                f"{time_left(last_time, last_T, t_env, cfg.t_max)}. "
-                f"Time passed: {time_str(time.time() - start_time)}")
-            last_time, last_T = time.time(), t_env
+            # train-stat cadence: runner_log_interval, epsilon alongside
+            # (reference parallel_runner.py:215-219). Deliberately after the
+            # train dispatch: at configs where B·T ≥ the interval this flush
+            # fires every iteration, and its blocking stat fetch then overlaps
+            # the already-enqueued train step instead of serializing it.
+            if t_env - last_runner_log_t >= cfg.runner_log_interval:
+                train_acc.flush(logger, t_env)
+                logger.log_stat("epsilon", train_acc.epsilon, t_env)
+                last_runner_log_t = t_env
 
-            with timer.stage("test"):
-                for _ in range(n_test_runs):
-                    rs, _, s = rollout(ts.learner.params["agent"], ts.runner,
-                                       test_mode=True)
-                    ts = ts.replace(runner=rs)
-                    test_acc.push(s)
-                    # Q10: flush only on the exact rounded quota
-                    if test_acc.n_episodes == test_quota:
-                        test_acc.flush(logger, t_env, prefix="test_")
-            last_test_t = t_env
+            # ---------------- test cadence (reference :240-256) ----------------
+            if (t_env - last_test_t) / cfg.test_interval >= 1.0:
+                log.info(f"t_env: {t_env} / {cfg.t_max}")
+                log.info(
+                    f"Estimated time left: "
+                    f"{time_left(last_time, last_T, t_env, cfg.t_max)}. "
+                    f"Time passed: {time_str(time.time() - start_time)}")
+                last_time, last_T = time.time(), t_env
 
-        # ---------------- animation cadence (reference :258-263) -----------
-        if (cfg.save_animation
-                and (t_env - last_anim_t) / cfg.animation_interval >= 1.0):
-            er = exp.episode_runner
-            if er_rs is None:
-                er_rs = er.init_state(jax.random.PRNGKey(cfg.seed + 3))
-            er_rs, _, _, traj = er.run(ts.learner.params["agent"], er_rs,
-                                       test_mode=True,
-                                       capture_trajectory=True)
-            p = er.save_animation(
-                traj, os.path.join(results_dir, f"animation_{t_env}.gif"))
-            if p:
-                log.info(f"animation saved to {p}")
-            last_anim_t = t_env
+                with timer.stage("test"):
+                    for _ in range(n_test_runs):
+                        rs, _, s = rollout(ts.learner.params["agent"], ts.runner,
+                                           test_mode=True)
+                        ts = ts.replace(runner=rs)
+                        test_acc.push(s)
+                        # Q10: flush only on the exact rounded quota
+                        if test_acc.n_episodes == test_quota:
+                            test_acc.flush(logger, t_env, prefix="test_")
+                last_test_t = t_env
 
-        # ---------------- save cadence (reference :265-279) ----------------
-        if cfg.save_model and (t_env - last_save_t) >= cfg.save_model_interval:
+            # ---------------- animation cadence (reference :258-263) -----------
+            if (cfg.save_animation
+                    and (t_env - last_anim_t) / cfg.animation_interval >= 1.0):
+                er = exp.episode_runner
+                if er_rs is None:
+                    er_rs = er.init_state(jax.random.PRNGKey(cfg.seed + 3))
+                er_rs, _, _, traj = er.run(ts.learner.params["agent"], er_rs,
+                                           test_mode=True,
+                                           capture_trajectory=True)
+                p = er.save_animation(
+                    traj, os.path.join(results_dir, f"animation_{t_env}.gif"))
+                if p:
+                    log.info(f"animation saved to {p}")
+                last_anim_t = t_env
+
+            # ---------------- save cadence (reference :265-279) ----------------
+            if cfg.save_model and (t_env - last_save_t) >= cfg.save_model_interval:
+                save_to = save_checkpoint(model_dir, t_env, ts)
+                log.info(f"Saving models to {save_to}")
+                if res.keep_last:
+                    prune_checkpoints(model_dir, res.keep_last, res.keep_every)
+                last_save_t = t_env
+
+            # ---------------- log cadence (reference :283-286) ------------------
+            if (t_env - last_log_t) >= cfg.log_interval:
+                if train_infos:
+                    # non-finite guard escalation: ONE blocking fetch for all
+                    # flags since the last cadence — the async dispatch
+                    # pipeline never syncs per train step. Deliberately after
+                    # the save cadence: the checkpoint written just above
+                    # (params finite by construction — tripped steps are
+                    # no-ops) is the state the restore wants.
+                    flags = np.asarray(jax.device_get(
+                        [i["all_finite"] for i in train_infos]))
+                    for ok in flags:
+                        if ok:
+                            nonfinite_streak = 0
+                        else:
+                            nonfinite_streak += 1
+                            nonfinite_total += 1
+                    if not flags.all():
+                        logger.log_stat("nonfinite_steps", nonfinite_total,
+                                        t_env)
+                        log.warning(
+                            f"non-finite loss/grads in "
+                            f"{int((~flags).sum())}/{len(flags)} train steps "
+                            f"since last log (streak={nonfinite_streak}, "
+                            f"total={nonfinite_total}); parameter updates "
+                            f"were skipped")
+                    last = jax.device_get(train_infos[-1])
+                    for k in ("loss", "grad_norm", "td_error_abs",
+                              "q_taken_mean", "target_mean"):
+                        logger.log_stat(k, float(last[k]), t_env)
+                    train_infos = []
+                    if (res.nonfinite_tolerance
+                            and nonfinite_streak >= res.nonfinite_tolerance):
+                        found = (find_checkpoint(model_dir)
+                                 if cfg.save_model else None)
+                        if found is None or restores >= res.max_restores:
+                            raise RuntimeError(
+                                f"training diverged: {nonfinite_streak} "
+                                f"consecutive non-finite train steps at "
+                                f"t_env={t_env} (last loss="
+                                f"{float(last['loss'])}, grad_norm="
+                                f"{float(last['grad_norm'])}), and "
+                                + (f"restore limit reached (resilience."
+                                   f"max_restores={res.max_restores})"
+                                   if found is not None else
+                                   "no valid checkpoint exists to restore "
+                                   "(save_model off or none written yet)")
+                                + " — the NaN source is persistent; inspect "
+                                "lr/grad_norm_clip/td_loss before rerunning")
+                        dirname, step = found
+                        log.warning(
+                            f"non-finite streak hit resilience."
+                            f"nonfinite_tolerance={res.nonfinite_tolerance}; "
+                            f"restoring last good checkpoint {dirname} "
+                            f"(restore {restores + 1}/{res.max_restores})")
+                        ts = load_checkpoint(dirname, ts, verify=False)
+                        ts = ts.replace(runner=ts.runner.replace(
+                            t_env=jnp.asarray(step, jnp.int32)))
+                        if dp is not None:
+                            ts = dp.shard(ts)
+                        # re-sync every host-side mirror of device state
+                        t_env = step
+                        episode = int(jax.device_get(ts.episode))
+                        if not exp.host_buffer:
+                            buffer_filled = int(jax.device_get(
+                                ts.buffer.episodes_in_buffer))
+                        inflight.clear()
+                        last_test_t = last_log_t = t_env
+                        last_runner_log_t = last_save_t = t_env
+                        restores += 1
+                        nonfinite_streak = 0
+                        continue
+                logger.log_stat("episode", episode, t_env)
+                # wall-clock throughput including everything (train, logging,
+                # cadences) — the honest live rate; the async loop makes the
+                # per-stage timings dispatch-enqueue times unless
+                # profile_stages is on
+                now = time.time()
+                if last_log_time is not None:
+                    logger.log_stat(
+                        "env_steps_per_sec",
+                        (t_env - last_log_t) / max(now - last_log_time, 1e-9),
+                        t_env)
+                last_log_time = now
+                timer.log_and_reset(logger, t_env)
+                logger.print_recent_stats()
+                last_log_t = t_env
+
+    finally:
+        guard.uninstall()
+
+    if guard.triggered:
+        # ---- preemption path: lose at most one iteration ---------------
+        log.warning(f"shutdown requested ({guard.signame}) at "
+                    f"t_env={t_env} — stopping gracefully")
+        if cfg.save_model and res.emergency_checkpoint:
             save_to = save_checkpoint(model_dir, t_env, ts)
-            log.info(f"Saving models to {save_to}")
-            last_save_t = t_env
-
-        # ---------------- log cadence (reference :283-286) ------------------
-        if (t_env - last_log_t) >= cfg.log_interval:
-            if train_infos:
-                last = jax.device_get(train_infos[-1])
-                for k in ("loss", "grad_norm", "td_error_abs",
-                          "q_taken_mean", "target_mean"):
-                    logger.log_stat(k, float(last[k]), t_env)
-                train_infos = []
-            logger.log_stat("episode", episode, t_env)
-            # wall-clock throughput including everything (train, logging,
-            # cadences) — the honest live rate; the async loop makes the
-            # per-stage timings dispatch-enqueue times unless
-            # profile_stages is on
-            now = time.time()
-            if last_log_time is not None:
-                logger.log_stat(
-                    "env_steps_per_sec",
-                    (t_env - last_log_t) / max(now - last_log_time, 1e-9),
-                    t_env)
-            last_log_time = now
-            timer.log_and_reset(logger, t_env)
-            logger.print_recent_stats()
-            last_log_t = t_env
-
-    log.info("Finished Training")
+            if res.keep_last:
+                prune_checkpoints(model_dir, res.keep_last, res.keep_every)
+            log.info(f"emergency checkpoint saved to {save_to}")
+        log.info(f"resume with checkpoint_path={model_dir} (newest valid "
+                 f"step selected automatically)")
+    else:
+        log.info("Finished Training")
     return ts
 
 
@@ -498,7 +613,7 @@ def evaluate_sequential(exp: Experiment, logger: Logger,
             from .utils.checkpoint import (CheckpointFormatError,
                                            load_learner_state)
             try:
-                ts = load_checkpoint(dirname, ts)
+                ts = load_checkpoint(dirname, ts, verify=False)
                 log.info(f"loaded full state from {dirname}")
             except CheckpointFormatError:
                 raise        # unreadable format: no fallback applies

@@ -1,4 +1,4 @@
-"""Checkpoint save/resume of the full train state.
+"""Checkpoint save/resume of the full train state — crash-safe.
 
 The reference checkpoints only model+optimizer tensors into
 ``<results>/models/<token>/<t_env>/`` and resumes by numeric-directory scan
@@ -12,26 +12,53 @@ target + optimizer, runner state incl. per-env Welford stats and PRNG keys,
 and optionally the replay buffer), serialized with flax msgpack — resume is
 exact, an intentional capability upgrade flagged in SURVEY.md §5(4).
 Directory layout and nearest-``load_step`` selection mirror the reference.
+
+Crash safety (docs/RESILIENCE.md): a write lands in a ``tmp.<t_env>``
+staging directory, is fsynced, and is published by one atomic ``rename`` —
+a crash at ANY point leaves either the previous checkpoint set intact or a
+``tmp.*`` leftover that the numeric scan never selects. ``meta.json``
+records a SHA-256 of ``state.msgpack``; ``find_checkpoint`` verifies each
+candidate and *skips back* to the newest VALID step instead of handing a
+torn or bit-flipped file to resume. ``prune_checkpoints`` bounds disk on
+long runs (keep newest K + every Nth step). Single writer per checkpoint
+directory assumed (the driver owns its token-unique ``models/<token>/``).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
-from typing import Any, Optional, Tuple
+import shutil
+from typing import Any, List, Optional, Tuple
 
 import jax
 from flax import serialization
 
+from . import resilience
+
+logger = logging.getLogger(__name__)
+
 #: bump when the checkpointed pytree layout changes incompatibly
 #: (v2: bool avail storage + meta sidecar; v3: RunnerState carries the
-#: per-lane reward-scale state)
+#: per-lane reward-scale state). The staged/atomic write and the sidecar's
+#: ``sha256``/``bytes`` keys are ADDITIVE — the tree layout is unchanged
+#: and old readers ignore unknown sidecar keys, so they do not bump this.
 FORMAT_VERSION = 3
 
 
 class CheckpointFormatError(ValueError):
     """The checkpoint's on-disk format is not readable by this build
     (newer FORMAT_VERSION). NOT a config mismatch — no fallback applies."""
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """The checkpoint bytes on disk do not match their recorded checksum
+    (torn write published by an old build, bit rot, manual tampering).
+    Deliberately NOT a ValueError: the model-only restore fallback that
+    callers apply to config mismatches is hopeless here — the bytes
+    themselves are bad."""
 
 
 def _obs_layout(state: Any) -> Optional[str]:
@@ -44,55 +71,194 @@ def _obs_layout(state: Any) -> Optional[str]:
             else "dense")
 
 
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _fsync_path(path: str) -> None:
+    """fsync a file OR directory entry so the rename-based publish is
+    durable, not merely atomic-in-page-cache."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def save_checkpoint(path: str, t_env: int, state: Any) -> str:
-    """Write ``<path>/<t_env>/state.msgpack`` + a ``meta.json`` sidecar
-    recording the format version and replay obs layout, so a restore with
-    a mismatched ``replay.compact_entity_store`` fails with the exact flag
-    to toggle instead of a deep msgpack structure error.
+    """Write ``<path>/<t_env>/{state.msgpack, meta.json}`` crash-safely.
+
+    The write is staged in ``<path>/tmp.<t_env>``: state bytes + fsync,
+    sidecar (format version, replay obs layout, sha256 + byte count of the
+    state blob) + fsync, then ONE ``os.rename`` publishes the directory
+    and the parent is fsynced. Readers therefore only ever see complete
+    checkpoints; a crash leaves a ``tmp.*`` directory the numeric scan in
+    ``find_checkpoint`` ignores (and ``prune_checkpoints`` sweeps). The
+    sidecar lets a restore with a mismatched ``replay.compact_entity_store``
+    fail with the exact flag to toggle instead of a deep msgpack error.
+
+    Re-saving an existing step (the preemption path's emergency checkpoint
+    can land on the save cadence's step) replaces the published directory.
 
     Multi-host (``jax.process_count() > 1``): leaves sharded over the
     global mesh are not host-addressable, so every process joins a
     ``process_allgather`` (a collective — ALL processes must call this
     function in lockstep) to assemble them, and only process 0 writes the
     file. Replicated leaves (params, optimizer — already host-local) skip
-    the gather entirely; only data-sharded leaves (the replay ring,
-    runner lanes) ride the collective. The checkpoint on disk is always
-    the complete global state, restorable on any topology (exact-resume
-    re-shards; model-only fallback via ``load_learner_state``). Known
-    cost at production ring sizes: the allgather materializes the ring on
-    EVERY host (~GiBs over DCN); a per-shard on-disk format (one file per
-    process, orbax-style) is the escape hatch if that ever dominates."""
+    the gather entirely; only data-sharded leaves (the replay ring, runner
+    lanes) ride the collective. Non-zero processes drop each gathered leaf
+    immediately instead of holding the assembled tree until the file write
+    — peak extra host RAM off process 0 is ONE leaf's gather, not the full
+    ring (ADVICE r5); process 0 logs the gathered byte count so the DCN
+    cost of the collective is visible in the run log. The checkpoint on
+    disk is always the complete global state, restorable on any topology
+    (exact-resume re-shards; model-only fallback via
+    ``load_learner_state``). A per-shard on-disk format (one file per
+    process, orbax-style) remains the escape hatch if even the one-leaf
+    transient ever dominates."""
     d = os.path.join(path, str(int(t_env)))
     if jax.process_count() > 1:
         import numpy as _np
         from jax.experimental import multihost_utils
 
+        # quiesce + align before the host-driven collective sequence: the
+        # driver dispatches asynchronously, so train-step collectives
+        # (grad psums) can still be in flight when save is called.
+        # Draining the device queue and barriering all processes first
+        # makes the gather sequence the only live collective traffic —
+        # cheap at save cadence, and it keeps a slow host from skewing
+        # the processes into interleaved collective orders.
+        jax.block_until_ready(state)
+        multihost_utils.sync_global_devices("save_checkpoint:begin")
+
+        writer = jax.process_index() == 0
+        gathered_bytes = [0]
+
         def _host_local(x):
             if not isinstance(x, jax.Array):
                 return x
             if x.is_fully_addressable:
-                return jax.device_get(x)
+                return jax.device_get(x) if writer else None
             if x.is_fully_replicated:
-                return _np.asarray(x)      # local shard already holds it
-            return multihost_utils.process_allgather(x, tiled=True)
+                # local shard already holds the value — no collective
+                return _np.asarray(x) if writer else None
+            # branch choice depends only on shardings — identical on every
+            # process, so the collectives stay in lockstep
+            g = multihost_utils.process_allgather(x, tiled=True)
+            if not writer:
+                return None          # freed now, not at function exit
+            gathered_bytes[0] += g.nbytes
+            return g
 
-        # branch choice depends only on shardings — identical on every
-        # process, so the collectives stay in lockstep
         state = jax.tree.map(_host_local, state)
-        if jax.process_index() != 0:
+        # trailing barrier: non-writers must not run ahead into the next
+        # collective phase (or interpreter shutdown) while the writer is
+        # mid-sequence — same transport race as above, from the other side
+        multihost_utils.sync_global_devices("save_checkpoint:end")
+        if not writer:
             return d
-    os.makedirs(d, exist_ok=True)
-    with open(os.path.join(d, "state.msgpack"), "wb") as f:
-        f.write(serialization.to_bytes(jax.device_get(state)))
-    with open(os.path.join(d, "meta.json"), "w") as f:
+        if gathered_bytes[0]:
+            logger.info(
+                "save_checkpoint t_env=%d: allgathered %.1f MiB of "
+                "data-sharded leaves over DCN", int(t_env),
+                gathered_bytes[0] / (1 << 20))
+
+    os.makedirs(path, exist_ok=True)
+    staging = os.path.join(path, f"tmp.{int(t_env)}")
+    if os.path.isdir(staging):
+        shutil.rmtree(staging)       # leftover from a crashed writer
+    os.makedirs(staging)
+
+    blob = serialization.to_bytes(jax.device_get(state))
+    state_path = os.path.join(staging, "state.msgpack")
+    with open(state_path, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    digest = hashlib.sha256(blob).hexdigest()
+    del blob
+    # fault-injection hook (tests): crash / truncate between the state
+    # write and the publish — the whole point of the staged layout
+    resilience.fire("checkpoint.staged", dirname=staging, t_env=int(t_env))
+    with open(os.path.join(staging, "meta.json"), "w") as f:
         json.dump({"format": FORMAT_VERSION, "obs_layout": _obs_layout(state),
-                   "t_env": int(t_env)}, f)
+                   "t_env": int(t_env), "sha256": digest,
+                   "bytes": os.path.getsize(state_path)}, f)
+        f.flush()
+        os.fsync(f.fileno())
+
+    displaced = None
+    if os.path.isdir(d):
+        # replacing an already-published step (emergency save landing on
+        # the save cadence's step): move the old version ASIDE instead of
+        # deleting it before the publish — with keep_last=1 retention
+        # there may be no older step to skip back to, and an rmtree here
+        # would leave a crash window with NOTHING on disk. Now the only
+        # exposure is the instant between the two renames, and even a
+        # crash there leaves this complete copy on disk (hand-recoverable
+        # by renaming it back; prune sweeps it otherwise).
+        displaced = os.path.join(path, f"tmp.{int(t_env)}.replaced")
+        if os.path.isdir(displaced):
+            shutil.rmtree(displaced)
+        os.rename(d, displaced)
+    os.rename(staging, d)            # the atomic publish
+    _fsync_path(path)                # make the rename itself durable
+    if displaced is not None:
+        shutil.rmtree(displaced)
     return d
 
 
-def find_checkpoint(path: str, load_step: int = 0) -> Optional[Tuple[str, int]]:
+def verify_checkpoint(dirname: str) -> bool:
+    """True iff ``dirname`` holds a restorable checkpoint.
+
+    New-format checkpoints (sidecar carries ``sha256``) verify by content
+    digest — catches truncation AND bit flips. Legacy sidecars without a
+    checksum are trusted on presence (their write order put ``meta.json``
+    last, so a sidecar implies the state blob completed). Sidecar-less
+    directories (pre-v2, or a torn legacy write that died mid-state) fall
+    back to a full msgpack parse — expensive, but only ever paid for
+    legacy candidates actually under consideration."""
+    state_path = os.path.join(dirname, "state.msgpack")
+    if not os.path.isfile(state_path):
+        return False
+    meta_path = os.path.join(dirname, "meta.json")
+    if os.path.isfile(meta_path):
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return False
+        want = meta.get("sha256")
+        if want is not None:
+            nbytes = meta.get("bytes")
+            if nbytes is not None and os.path.getsize(state_path) != nbytes:
+                return False         # cheap reject before hashing
+            return _sha256_file(state_path) == want
+        return True                  # legacy sidecar: meta written last
+    try:                             # sidecar-less legacy: parse or reject
+        with open(state_path, "rb") as f:
+            serialization.msgpack_restore(f.read())
+        return True
+    except Exception:                # truncated/garbled msgpack
+        return False
+
+
+def find_checkpoint(path: str, load_step: int = 0,
+                    verify: bool = True) -> Optional[Tuple[str, int]]:
     """Scan numeric subdirs; pick max ``t_env`` when ``load_step == 0`` else
-    the nearest to ``load_step`` (reference ``per_run.py:171-182``)."""
+    the nearest to ``load_step`` (reference ``per_run.py:171-182``; ties
+    resolve to the SMALLER step, deterministically). Candidates failing
+    :func:`verify_checkpoint` are skipped — selection falls back to the
+    next-best valid step, so one torn top checkpoint no longer kills
+    resume. ``tmp.*`` staging leftovers are never candidates (non-numeric
+    names)."""
     if not os.path.isdir(path):
         return None
     steps = [int(name) for name in os.listdir(path)
@@ -101,16 +267,67 @@ def find_checkpoint(path: str, load_step: int = 0) -> Optional[Tuple[str, int]]:
     if not steps:
         return None
     if load_step == 0:
-        step = max(steps)
+        ordered = sorted(steps, reverse=True)                  # newest first
     else:
-        step = min(steps, key=lambda s: abs(s - load_step))
-    return os.path.join(path, str(step)), step
+        ordered = sorted(steps, key=lambda s: (abs(s - load_step), s))
+    for step in ordered:
+        d = os.path.join(path, str(step))
+        if not verify or verify_checkpoint(d):
+            return d, step
+        logger.warning(
+            "find_checkpoint: skipping corrupt/torn checkpoint %s "
+            "(integrity check failed) — falling back to the next valid "
+            "step", d)
+    logger.warning("find_checkpoint: no valid checkpoint under %s "
+                   "(%d candidates, all failed verification)", path,
+                   len(steps))
+    return None
 
 
-def load_checkpoint(dirname: str, target: Any) -> Any:
+def prune_checkpoints(path: str, keep_last: int = 5,
+                      keep_every: int = 0) -> List[int]:
+    """Retention for long runs: keep the newest ``keep_last`` steps plus —
+    when ``keep_every > 0`` — every step divisible by ``keep_every``
+    (coarse history for post-hoc analysis); delete the rest. Also sweeps
+    ``tmp.*`` staging leftovers from crashed writers. Returns the deleted
+    steps. Safe to call after every save; single writer assumed.
+
+    Multi-host: a no-op off process 0 — only the checkpoint writer prunes.
+    On a shared filesystem a non-writer sweeping ``tmp.*`` would race the
+    writer's in-flight staging directory (every process runs the driver's
+    save cadence, but only process 0 owns the files)."""
+    if jax.process_index() != 0:
+        return []
+    if not os.path.isdir(path):
+        return []
+    steps = sorted(int(n) for n in os.listdir(path)
+                   if n.isdigit() and os.path.isdir(os.path.join(path, n)))
+    keep = set(steps[-max(keep_last, 1):])
+    if keep_every > 0:
+        keep.update(s for s in steps if s % keep_every == 0)
+    removed = []
+    for s in steps:
+        if s not in keep:
+            shutil.rmtree(os.path.join(path, str(s)), ignore_errors=True)
+            removed.append(s)
+    for n in os.listdir(path):
+        if n.startswith("tmp.") and os.path.isdir(os.path.join(path, n)):
+            shutil.rmtree(os.path.join(path, n), ignore_errors=True)
+    if removed:
+        logger.info("prune_checkpoints: removed %d old checkpoints under "
+                    "%s (kept %d)", len(removed), path, len(keep))
+    return removed
+
+
+def load_checkpoint(dirname: str, target: Any, verify: bool = True) -> Any:
     """Restore into a template pytree of the same structure. The
     ``meta.json`` sidecar (when present) turns a replay-layout mismatch
-    into a precise config instruction before any deserialization."""
+    into a precise config instruction before any deserialization, and its
+    checksum (when present) turns silent corruption into
+    :class:`CheckpointIntegrityError` before flax sees a single byte.
+    Callers that just selected ``dirname`` via :func:`find_checkpoint`
+    already paid the SHA-256 pass there and may set ``verify=False`` to
+    skip re-hashing (one full read of a multi-GiB ring is real time)."""
     meta_path = os.path.join(dirname, "meta.json")
     meta = None
     if os.path.exists(meta_path):
@@ -134,6 +351,16 @@ def load_checkpoint(dirname: str, target: Any) -> Any:
                 f"checkpoint (docs/SPEC.md perf modes)")
     with open(os.path.join(dirname, "state.msgpack"), "rb") as f:
         data = f.read()
+    if verify and meta is not None and meta.get("sha256") is not None:
+        digest = hashlib.sha256(data).hexdigest()
+        if digest != meta["sha256"]:
+            raise CheckpointIntegrityError(
+                f"checkpoint {dirname} fails its integrity check: "
+                f"state.msgpack hashes to {digest[:12]}… but meta.json "
+                f"recorded {meta['sha256'][:12]}… — the file is torn or "
+                f"corrupted; resume from an older step "
+                f"(find_checkpoint skips invalid checkpoints "
+                f"automatically)")
     try:
         if meta is None or meta.get("format", 0) < 3:
             # v2 → v3 migration: v3 added RunnerState.rscale. No v2 run

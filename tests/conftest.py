@@ -3,14 +3,28 @@
 SURVEY.md §4: multi-chip paths are tested without a cluster, on a faked
 8-device CPU mesh. Environment traps: the axon sitecustomize registers a TPU
 backend at interpreter start, and ``import pytest`` itself imports jax
-(plugin entry points), so env-var mutation here is too late. The jax config
-API works post-import because backends initialize lazily:
-``jax_platforms='cpu'`` overrides the axon selection and
-``jax_num_cpu_devices=8`` replaces ``xla_force_host_platform_device_count``.
+(plugin entry points), so env-var mutation here is *almost* too late. The
+jax config API works post-import because backends initialize lazily:
+``jax_platforms='cpu'`` overrides the axon selection. The device-count knob
+is version-dependent: ``jax_num_cpu_devices`` only exists on newer JAX; on
+older builds (0.4.x) the only lever is ``XLA_FLAGS=
+--xla_force_host_platform_device_count=8`` — which is read at CPU-backend
+init, and backends are lazy, so mutating ``os.environ`` here (before any
+device query has run) still takes effect.
 """
+
+import os
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older JAX: the flag must land before the (lazy) CPU backend
+    # initializes; appending preserves any operator-set XLA flags
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 jax.config.update("jax_default_matmul_precision", "highest")

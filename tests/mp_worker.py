@@ -73,18 +73,42 @@ def main() -> int:
     exp = Experiment.build(cfg)
     mesh = make_mesh(8)
     dp = DataParallel(exp, mesh)
-    # every process computes the identical initial state (same seed);
-    # shard() places each process's local shards of the global arrays
-    ts = dp.shard(exp.init_train_state(0))
+    # every process computes the identical initial state (same seed), so
+    # each can build its LOCAL shards of the global arrays directly
+    # (make_array_from_callback) — zero cross-process traffic. The
+    # obvious dp.shard()/device_put route funnels its per-device
+    # transfers through the gloo tcp pair concurrently, which races on
+    # an oversubscribed CPU box (pre-existing jaxlib flake: gloo
+    # EnforceNotMet preamble-size mismatch — observed even for a single
+    # scalar leaf). On a real TPU pod dp.shard is ICI/DCN traffic and
+    # stays the production path.
+    import numpy as np
+
+    def _place(x, s):
+        arr = np.asarray(jax.device_get(x))
+        return jax.make_array_from_callback(arr.shape, s,
+                                            lambda idx: arr[idx])
+
+    init = exp.init_train_state(0)
+    ts = jax.tree.map(_place, init, dp.state_shardings(init))
     rollout, insert, train_iter = dp.jitted_programs()
 
+    # block after every program: the driver's async dispatch is the point
+    # in production, but on the gloo CPU transport two overlapping
+    # executables whose collectives interleave on one tcp pair race the
+    # transport (observed flake: gloo EnforceNotMet preamble-size
+    # mismatch, a pre-existing jaxlib/gloo issue on oversubscribed CPU) —
+    # the worker is a correctness fixture, so serialize for determinism
     rs, batch, _ = rollout(ts.learner.params["agent"], ts.runner,
                            test_mode=False)
+    jax.block_until_ready((rs, batch))
     obs_leaf = jax.tree.leaves(batch.obs)[0]
     assert len(obs_leaf.sharding.device_set) == 8, "episode axis not global"
     ts = ts.replace(runner=rs, buffer=insert(ts.buffer, batch),
                     episode=ts.episode + cfg.batch_size_run)
+    jax.block_until_ready(ts.buffer)
     ts, info = train_iter(ts, jax.random.PRNGKey(1), jnp.asarray(32))
+    jax.block_until_ready(ts)
     loss = float(jax.device_get(info["loss"]))
     assert jnp.isfinite(loss)
     leaf = jax.tree.leaves(ts.learner.params)[0]
@@ -109,7 +133,16 @@ if __name__ == "__main__":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 4)
+    try:
+        jax.config.update("jax_num_cpu_devices", 4)
+    except AttributeError:
+        # older JAX (0.4.x): same lazy-backend fallback as tests/conftest.py
+        # — but REPLACE any inherited count (the parent pytest process
+        # exports 8; each of the 2 workers must present 4 local devices)
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append("--xla_force_host_platform_device_count=4")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
     # CPU cross-process collectives backend (jaxlib ships gloo); a TPU pod
     # uses the ICI/DCN fabric instead, so this stays test-side
     jax.config.update("jax_cpu_collectives_implementation", "gloo")

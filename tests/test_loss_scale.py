@@ -215,13 +215,13 @@ def test_train_step_with_gate_opens_gate(setup):
     mac = BasicMAC.build(cfg2, info)
     lrn = QMixLearner.build(cfg2, mac, info)
     ls2 = lrn.init_state(jax.random.PRNGKey(0))
-    assert float(np.asarray(
-        ls2.params["mixer"]["params"]["out_gate"])) == 0.0
+    assert np.asarray(
+        ls2.params["mixer"]["params"]["out_gate"]).item() == 0.0
     ls3, info3 = jax.jit(lrn.train)(ls2, sample, w, jnp.asarray(0),
                                     jnp.asarray(2))
     assert np.isfinite(float(info3["loss"]))
-    assert float(np.abs(np.asarray(
-        ls3.params["mixer"]["params"]["out_gate"]))) > 0.0
+    assert np.abs(np.asarray(
+        ls3.params["mixer"]["params"]["out_gate"])).item() > 0.0
 
 
 def test_sanity_check_validates_lever_flags():
