@@ -20,10 +20,55 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+
+def probe_backend(probe_s: float) -> "dict | None":
+    """Bounded backend-init probe in a SUBPROCESS, one retry. A wedged
+    axon tunnel blocks ``jax.devices()`` ~25 min inside backend init
+    (BASELINE.md) — longer than most callers' own timeouts — and a
+    blocked in-process thread can never be joined, so the probe runs
+    ``jax.devices()`` in a child process the parent can kill at the
+    bound. Returns ``None`` on success, else a structured
+    ``{"error", "phase"}`` dict for the failure record. A healthy init
+    is seconds; the bound only fires on a dead tunnel, where no claim is
+    held yet, so killing the child cannot wedge the remote further.
+
+    Deliberate cost: the child's backend init is thrown away, so a
+    healthy run initializes twice (seconds on CPU/local TPU). That buys
+    a killable probe — the previous in-process thread could never be
+    joined once wedged and had to ``os._exit`` the whole bench — plus
+    the retry, which distinguishes a transient tunnel blip from a wedge
+    before any measurement time is spent."""
+    # the bound is TOTAL across both attempts (probe_s/2 each): callers
+    # tune their own timeouts against probe_s, and a retry that doubled
+    # the worst case would push the error record past them — recreating
+    # the no-record-on-stdout failure this probe exists to prevent
+    per_attempt = probe_s / 2.0
+    last = "probe never ran"
+    for attempt in (1, 2):
+        if per_attempt <= 0:
+            last = (f"backend init exceeded {per_attempt:.0f}s probe "
+                    f"bound (attempt {attempt}/2; wedged tunnel?)")
+            continue
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True, text=True, timeout=per_attempt)
+        except subprocess.TimeoutExpired:
+            last = (f"backend init exceeded {per_attempt:.0f}s probe "
+                    f"bound (attempt {attempt}/2; wedged tunnel?)")
+            continue
+        if proc.returncode == 0:
+            return None
+        last = (f"backend unavailable (attempt {attempt}/2): "
+                f"{proc.stderr.strip()[-400:]}")
+    return {"error": last[:500], "phase": "backend_init"}
 
 
 def _sync(x):
@@ -358,6 +403,65 @@ def bench_dp(cfg, _time, args) -> int:
         rec = rollout_rec
     rec.update(pipe_keys)
     print(json.dumps(rec))
+    return 0
+
+
+def bench_superstep(cfg, _time, args) -> int:
+    """``--superstep K``: the dispatch-amortized training rate. ONE fused
+    XLA program scans K rollout → in-place ring insert → (gated)
+    sample+train iterations per dispatch
+    (``run.Experiment.superstep_program``) — the rate the production
+    driver sees at ``superstep=K``, where the per-dispatch tunnel
+    round-trip (~0.66 s, BASELINE.md) is paid once per K full train
+    iterations instead of 3× per iteration. Headline: env-steps/s over
+    the whole dispatch INCLUDING training."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from t2omca_tpu.run import Experiment
+
+    k = args.superstep
+    bs = 4 if args.smoke else 32
+    b = cfg.batch_size_run
+    cfg = cfg.replace(
+        batch_size=bs,
+        replay=dataclasses.replace(
+            cfg.replay, prioritized=True,
+            buffer_size=max(cfg.replay.buffer_size, 2 * b, bs)))
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(0)
+    # un-donated: the timed dispatches re-run on the same warmed state
+    superstep = exp.superstep_program(k)
+    keys = jax.random.split(jax.random.PRNGKey(7), k)
+    t_len = cfg.env_args.episode_limit
+    # warm dispatch (compile + ring fill: k·b episodes) so the timed
+    # dispatches exercise the train branch of the gate
+    ts, _, _ = superstep(ts, keys, jnp.zeros((), jnp.int32))
+    gate_open = int(jax.device_get(ts.buffer.episodes_in_buffer)) >= bs
+
+    dt = _time(lambda: superstep(ts, keys,
+                                 jnp.asarray(1000, jnp.int32))[1].epsilon[-1])
+    env_steps = k * b * t_len
+    rate = env_steps / dt
+    print(f"# superstep K={k}: {dt * 1e3:.1f} ms/dispatch for {env_steps} "
+          f"env-steps + {k if gate_open else 0} train iters "
+          f"({b} envs x {t_len} slots, train batch {bs})", file=sys.stderr)
+    print(json.dumps({
+        "metric": "env_steps_per_sec",
+        "value": round(rate, 1),
+        "unit": "env-steps/s/chip",
+        "vs_baseline": round(rate / 50_000.0, 3),
+        "superstep": k,
+        "config": (None if args.smoke or args.envs or args.steps
+                   else args.config),
+        "n_envs": b,
+        "episode_steps": t_len,
+        "train_batch_episodes": bs,
+        "train_gate_open": gate_open,
+        "dispatch_s": round(dt, 4),
+    }))
     return 0
 
 
@@ -763,6 +867,13 @@ def main() -> int:
                     help="PRNG impl for all keys: rbg = the TPU hardware "
                          "bit generator (cheaper for the rollout's many "
                          "small draws; different stream than threefry)")
+    ap.add_argument("--superstep", type=int, default=None, metavar="K",
+                    help="measure the fused training superstep: ONE "
+                         "program scanning K rollout->insert->train "
+                         "iterations per dispatch (config superstep=K; "
+                         "K=1 still fuses the three stages into one "
+                         "program). Reports the dispatch-amortized "
+                         "env-steps/s including training")
     ap.add_argument("--pipeline", type=int, default=None, metavar="K",
                     help="also report the steady-state rate over K "
                          "async-chained rollouts with one terminal sync "
@@ -771,6 +882,17 @@ def main() -> int:
                          "defaults to K=4 on full-scale runs, pass 0 "
                          "to disable")
     args = ap.parse_args()
+    if args.superstep is not None:
+        if args.superstep < 1:
+            ap.error("--superstep K must be >= 1")
+        if (args.all or args.hbm or args.prod_hbm or args.breakdown
+                or args.train or args.config == 5):
+            ap.error("--superstep measures the fused-dispatch loop on a "
+                     "single chip; drop --all/--hbm/--prod-hbm/"
+                     "--breakdown/--train/--config 5")
+        if args.pipeline:
+            ap.error("--superstep already amortizes dispatch inside one "
+                     "program; drop --pipeline")
     if args.pipeline is not None and args.pipeline < 0:
         ap.error("--pipeline K must be >= 0")
     if args.pipeline and (args.hbm or args.breakdown or args.prod_hbm):
@@ -785,7 +907,8 @@ def main() -> int:
         # steady-state rate; --pipeline 0 disables. Smoke stays off (the
         # CPU contract tests pin the minimal schema).
         measures_chain = not (args.smoke or args.hbm or args.breakdown
-                              or args.prod_hbm)
+                              or args.prod_hbm
+                              or args.superstep is not None)
         args.pipeline = 4 if measures_chain else 0
 
     if args.smoke or args.hbm:
@@ -798,45 +921,19 @@ def main() -> int:
     import jax.numpy as jnp
 
     if not args.smoke and not args.hbm:
-        # probe the backend FIRST, and time-bound the probe: a wedged
-        # axon tunnel blocks backend init ~25 min before erroring (see
-        # BASELINE.md), which can outlast the caller's own timeout — the
-        # record must land BEFORE that. A healthy init is seconds; the
-        # bound only fires on a dead tunnel, where no claim is held yet,
-        # so exiting cannot wedge the remote further.
-        import os
-        import threading
+        # probe the backend FIRST, bounded in a subprocess (probe_backend):
+        # the parseable error record must land BEFORE any caller timeout.
         metric, unit = (("train_steps_per_sec", "train-steps/s/chip")
                         if args.train
                         else ("env_steps_per_sec", "env-steps/s/chip"))
-
-        def _error_record(msg: str) -> None:
-            print(json.dumps({
-                "metric": metric, "value": None,
-                "unit": unit, "vs_baseline": None,
-                "error": msg[:500],
-            }), flush=True)
-
         probe_s = float(os.environ.get("T2OMCA_BACKEND_PROBE_TIMEOUT",
                                        "900"))
-        result = {}
-
-        def _probe():
-            try:
-                jax.devices()
-                result["ok"] = True
-            except RuntimeError as e:
-                result["error"] = str(e)
-
-        th = threading.Thread(target=_probe, daemon=True)
-        th.start()
-        th.join(timeout=probe_s if probe_s > 0 else 0)
-        if probe_s <= 0 or th.is_alive():
-            _error_record(f"backend init exceeded {probe_s:.0f}s probe "
-                          f"bound (wedged tunnel?)")
-            os._exit(1)      # the blocked init thread cannot be joined
-        if "error" in result:
-            _error_record(f"backend unavailable: {result['error']}")
+        failure = probe_backend(probe_s)
+        if failure is not None:
+            print(json.dumps({
+                "metric": metric, "value": None,
+                "unit": unit, "vs_baseline": None, **failure,
+            }), flush=True)
             return 1
 
     from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,
@@ -920,6 +1017,10 @@ def main() -> int:
             jax.profiler.stop_trace()
             print(f"# trace written to {args.profile}", file=sys.stderr,
                   flush=True)
+
+    if args.superstep is not None:
+        with tracing():
+            return bench_superstep(cfg, _time, args)
 
     if args.prod_hbm:
         if jax.device_count() < 8:

@@ -83,6 +83,51 @@ class EpisodeBatch:
 
 
 @struct.dataclass
+class TimeMajorEpisodes:
+    """Rollout-scan emission BEFORE episode-batch assembly: the ``(T, B,
+    ...)`` stacked per-step outputs plus the ``(B, ...)`` bootstrap step.
+    The fused superstep path (``run.Experiment.superstep_program``)
+    scatters these straight into the replay ring
+    (``ReplayBuffer.insert_time_major``) without ever materializing the
+    concatenated ``(B, T+1, ...)`` episode batch — the batch→copy HBM
+    round-trip BASELINE.md flags on the bandwidth-bound path. The
+    classic path assembles the same values into an ``EpisodeBatch`` via
+    ``to_batch()`` (bit-identical contents either way)."""
+
+    obs: jnp.ndarray            # (T, B, A, obs) storage-cast — or a
+                                # CompactEntityObs pytree, time-major
+    state: jnp.ndarray          # (T, B, state_dim) storage-cast
+    avail_actions: jnp.ndarray  # (T, B, A, n_actions) bool
+    actions: jnp.ndarray        # (T, B, A) int32
+    reward: jnp.ndarray         # (T, B) float32 (train-recorded reward)
+    terminated: jnp.ndarray     # (T, B) bool (env-terminal, Q7)
+    last_obs: jnp.ndarray       # (B, A, obs) bootstrap step — or compact
+    last_state: jnp.ndarray     # (B, state_dim)
+    last_avail: jnp.ndarray     # (B, A, n_actions) bool
+
+    @property
+    def batch_size(self) -> int:
+        return self.actions.shape[1]
+
+    def to_batch(self) -> EpisodeBatch:
+        """Assemble the classic ``(B, T(+1), ...)`` episode batch."""
+        b, t = self.actions.shape[1], self.actions.shape[0]
+        bt = lambda x: jnp.swapaxes(x, 0, 1)
+        cat_last = lambda seq, last: jax.tree.map(
+            lambda s, l: jnp.concatenate([bt(s), l[:, None]], axis=1),
+            seq, last)
+        return EpisodeBatch(
+            obs=cat_last(self.obs, self.last_obs),
+            state=cat_last(self.state, self.last_state),
+            avail_actions=cat_last(self.avail_actions, self.last_avail),
+            actions=bt(self.actions),
+            reward=bt(self.reward),
+            terminated=bt(self.terminated),
+            filled=jnp.ones((b, t), bool),
+        )
+
+
+@struct.dataclass
 class BufferState:
     """Ring buffer over episodes + PER priorities, all device-resident."""
 
@@ -152,13 +197,11 @@ class ReplayBuffer:
             max_priority=jnp.ones((), jnp.float32),
         )
 
-    def insert_episode_batch(self, state: BufferState,
-                             batch: EpisodeBatch) -> BufferState:
-        """Ring-insert ``B`` episodes; overwrites oldest when full (the
-        reference's EpisodeBatch ring semantics). New episodes get the running
-        max priority (standard PER; reference feeds real |TD| back after the
-        first sample, Q9)."""
-        b = batch.batch_size
+    def _ring_slots(self, state: BufferState, b: int) -> jnp.ndarray:
+        """Target slots for ``b`` incoming episodes, with the shared
+        capacity guard — ONE source for both insert paths (their ring
+        bookkeeping must stay bit-identical: superstep K=1 parity,
+        docs/SPEC.md §8)."""
         if b > self.capacity:
             # ring indices would repeat within one scatter and XLA's order
             # for duplicate indices is unspecified → arbitrary contents
@@ -166,12 +209,14 @@ class ReplayBuffer:
                 f"insert batch of {b} episodes exceeds buffer capacity "
                 f"{self.capacity}; raise replay.buffer_size above "
                 f"batch_size_run")
-        idx = (state.insert_pos + jnp.arange(b)) % self.capacity
-        # cast to the ring's storage dtypes (int32-avail producers stay
-        # legal; scatter dtype mismatches become hard errors in newer JAX)
-        storage = jax.tree.map(
-            lambda s, x: s.at[idx].set(x.astype(s.dtype)), state.storage,
-            batch)
+        return (state.insert_pos + jnp.arange(b)) % self.capacity
+
+    def _ring_advance(self, state: BufferState, storage: EpisodeBatch,
+                      idx: jnp.ndarray, b: int) -> BufferState:
+        """Post-insert bookkeeping shared by both insert paths: advance
+        the ring cursor/fill and stamp new episodes at the running max
+        priority (standard PER; reference feeds real |TD| back after the
+        first sample, Q9)."""
         return state.replace(
             storage=storage,
             insert_pos=(state.insert_pos + b) % self.capacity,
@@ -179,6 +224,56 @@ class ReplayBuffer:
                 state.episodes_in_buffer + b, self.capacity),
             priorities=state.priorities.at[idx].set(state.max_priority),
         )
+
+    def insert_episode_batch(self, state: BufferState,
+                             batch: EpisodeBatch) -> BufferState:
+        """Ring-insert ``B`` episodes; overwrites oldest when full (the
+        reference's EpisodeBatch ring semantics)."""
+        b = batch.batch_size
+        idx = self._ring_slots(state, b)
+        # cast to the ring's storage dtypes (int32-avail producers stay
+        # legal; scatter dtype mismatches become hard errors in newer JAX)
+        storage = jax.tree.map(
+            lambda s, x: s.at[idx].set(x.astype(s.dtype)), state.storage,
+            batch)
+        return self._ring_advance(state, storage, idx, b)
+
+    def insert_time_major(self, state: BufferState,
+                          tm: TimeMajorEpisodes) -> BufferState:
+        """Ring-insert straight from the rollout scan's time-major
+        emission: two scatters per (T+1)-length leaf (steps 0..T-1 from
+        the stacked scan output, step T from the bootstrap step) instead
+        of concatenate-into-an-episode-batch-then-copy. Contents are
+        bit-identical to ``insert_episode_batch(state, tm.to_batch())``
+        — the fused superstep relies on that for K=1 parity — but the
+        ``(B, T+1, ...)`` intermediate never exists, which matters inside
+        the donated superstep program where the ring is updated in
+        place."""
+        b = tm.batch_size
+        idx = self._ring_slots(state, b)
+
+        def put_tp1(s, seq, last):
+            """(cap, T+1, ...) leaf ← (T, B, ...) scan stack + (B, ...)."""
+            s = s.at[idx, :-1].set(
+                jnp.swapaxes(seq, 0, 1).astype(s.dtype))
+            return s.at[idx, -1].set(last.astype(s.dtype))
+
+        def put_t(s, seq):
+            """(cap, T, ...) leaf ← (T, B, ...) scan stack."""
+            return s.at[idx].set(jnp.swapaxes(seq, 0, 1).astype(s.dtype))
+
+        st = state.storage
+        storage = st.replace(
+            obs=jax.tree.map(put_tp1, st.obs, tm.obs, tm.last_obs),
+            state=put_tp1(st.state, tm.state, tm.last_state),
+            avail_actions=put_tp1(st.avail_actions, tm.avail_actions,
+                                  tm.last_avail),
+            actions=put_t(st.actions, tm.actions),
+            reward=put_t(st.reward, tm.reward),
+            terminated=put_t(st.terminated, tm.terminated),
+            filled=st.filled.at[idx].set(True),
+        )
+        return self._ring_advance(state, storage, idx, b)
 
     def can_sample(self, state: BufferState, batch_size: int) -> jnp.ndarray:
         return state.episodes_in_buffer >= batch_size

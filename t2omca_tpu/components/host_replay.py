@@ -144,10 +144,21 @@ class HostReplayBuffer:
         self._count = 0
         self._max_priority = 1.0
         self._rng = np.random.default_rng(0)
+        # deferred priority feedback (run.py host path): (idx, td_ref,
+        # finite_ref) device refs whose host fetch is consumed at the
+        # NEXT sample instead of blocking the train iteration
+        self._pending_update = None
 
     # ------------------------------------------------------------- protocol
 
     def insert_episode_batch(self, batch: EpisodeBatch) -> None:
+        # consume any deferred priority feedback BEFORE the insert can
+        # overwrite its slots: on ring wrap-around the deferred idx may be
+        # exactly the slots this batch reuses, and flushing after would
+        # stamp the EVICTED episodes' |TD| onto the fresh episodes
+        # (which must start at max_priority) — flushing here keeps the
+        # sum-tree byte-identical to the old synchronous update order
+        self.flush_priority_updates()
         host = jax.device_get(batch)
         b = host.obs.shape[0]
         idx = (self._pos + np.arange(b)) % self.capacity
@@ -164,8 +175,43 @@ class HostReplayBuffer:
     def can_sample(self, batch_size: int) -> bool:
         return self._count >= batch_size
 
+    def defer_priority_update(self, idx: np.ndarray, td_ref, finite_ref
+                              ) -> None:
+        """Asynchronous replacement for the post-train ``update_priorities``
+        call: start the device→host copies NOW (non-blocking) and stash
+        the refs; the fetch is consumed by ``flush_priority_updates`` at
+        the next ``sample`` — by which point a full rollout has executed
+        and the copy has long landed, so the ``np.asarray`` there is a
+        wait-free read instead of the ~0.66 s blocking round-trip the
+        axon tunnel charges per ``jax.device_get`` (BASELINE.md). The
+        sampling distribution sees each step's |TD| one iteration late —
+        the same deferral the device path's async dispatch pipeline
+        already has."""
+        if not self.prioritized:
+            return
+        self.flush_priority_updates()      # at most one in flight
+        for ref in (td_ref, finite_ref):
+            start = getattr(ref, "copy_to_host_async", None)
+            if start is not None:
+                start()
+        self._pending_update = (np.asarray(idx, np.int64), td_ref,
+                                finite_ref)
+
+    def flush_priority_updates(self) -> None:
+        """Consume the deferred priority feedback, if any. A tripped
+        (non-finite) train step leaves the sum-tree untouched — NaN
+        priorities would corrupt it permanently."""
+        if self._pending_update is None:
+            return
+        idx, td_ref, finite_ref = self._pending_update
+        self._pending_update = None
+        if bool(np.asarray(jax.device_get(finite_ref))):
+            td = np.asarray(jax.device_get(td_ref), np.float64)
+            self.update_priorities(idx, td + 1e-6)             # Q9
+
     def sample(self, batch_size: int, t_env: int
                ) -> Tuple[EpisodeBatch, np.ndarray, np.ndarray]:
+        self.flush_priority_updates()
         n = self._count
         if self.prioritized:
             us = self._rng.random(batch_size)

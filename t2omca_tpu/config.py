@@ -179,6 +179,21 @@ class TrainConfig:
     batch_size_run: int = 8               # parallel envs (vmapped, not subprocesses)
     batch_size: int = 32                  # train batch (episodes)
     accumulated_episodes: int = 0         # min episodes collected before training
+    # Anakin-style fused training superstep (Podracer, PAPERS.md): K > 1
+    # fuses rollout → ring insert → gated sample+train into ONE donated
+    # XLA program and lax.scan-s it K iterations per dispatch — amortizing
+    # the per-dispatch overhead (~0.66 s under the axon tunnel,
+    # BASELINE.md) over K full train iterations and never materializing
+    # the episode batch between rollout and insert (the rollout's scan
+    # outputs scatter straight into the replay ring). 1 = the classic
+    # three-program loop (bit-identical training either way — pinned by
+    # tests/test_superstep.py). Requires the device-resident ring:
+    # buffer_cpu_only configs stay on the three-program path
+    # (run.superstep_eligible, the ops/query_slice.py predicate pattern).
+    # Cadences (test/log/save) and preemption/checkpoint boundaries land
+    # between dispatches, so they coarsen to every K iterations and a
+    # preemption loses at most K iterations (docs/SPEC.md §8).
+    superstep: int = 1
     use_cuda: bool = False                # parity flag; device selection is JAX's
     # data parallelism (SURVEY.md §7.2(6)): shard env lanes + replay
     # episodes over a `dp_devices`-wide mesh data axis (parallel/mesh.py);
@@ -301,6 +316,9 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
         raise ValueError(f"huber_delta must be > 0, got {cfg.huber_delta}")
     if cfg.reward_unit <= 0:
         raise ValueError(f"reward_unit must be > 0, got {cfg.reward_unit}")
+    if cfg.superstep < 1:
+        raise ValueError(f"superstep must be >= 1 (1 = the unfused "
+                         f"three-program loop), got {cfg.superstep}")
     if cfg.reward_unit != 1.0 and cfg.env_args.reward_scaling:
         raise ValueError(
             "reward_unit and env_args.reward_scaling are alternative "

@@ -35,8 +35,12 @@ class NormState:
 
     @classmethod
     def create(cls, dim: int) -> "NormState":
-        z = jnp.zeros((dim,), jnp.float32)
-        return cls(n=jnp.zeros((), jnp.int32), mean=z, s=z, std=z)
+        # three DISTINCT zero buffers, not one shared array: a freshly
+        # created state may be donated whole (the fused superstep donates
+        # the full TrainState), and XLA rejects donating the same buffer
+        # through two leaves ("donate twice in Execute")
+        z = lambda: jnp.zeros((dim,), jnp.float32)
+        return cls(n=jnp.zeros((), jnp.int32), mean=z(), s=z(), std=z())
 
 
 def welford_update(state: NormState, x: jnp.ndarray) -> NormState:

@@ -408,6 +408,21 @@ class QMixLearner:
 
     # ------------------------------------------------------------------ train
 
+    def train_info_zeros(self, batch_size: int) -> Dict[str, jnp.ndarray]:
+        """Aval-matched zero info dict for a SKIPPED train step — the
+        superstep's ``lax.cond`` needs both branches to return identical
+        pytrees (``run.Experiment.superstep_program``). Must mirror the
+        keys/shapes/dtypes ``train`` emits; ``all_finite=True`` so skipped
+        sub-iterations never feed the driver's non-finite streak
+        accounting."""
+        z = jnp.zeros((), jnp.float32)
+        return {
+            "loss": z, "td_error_abs": z, "q_taken_mean": z,
+            "target_mean": z, "grad_norm": z,
+            "td_errors_abs": jnp.zeros((batch_size,), jnp.float32),
+            "all_finite": jnp.ones((), bool),
+        }
+
     def train(self, ls: LearnerState, batch: EpisodeBatch,
               weights: jnp.ndarray, t_env: jnp.ndarray,
               episode: jnp.ndarray, key: Optional[jax.Array] = None

@@ -142,6 +142,24 @@ class DataParallel:
         ``donate`` has the same contract as
         ``Experiment.jitted_programs(donate=...)``: in-place replay ring and
         train state for drivers that never reuse the pre-call value."""
+        return self.exp.jitted_programs(donate=donate,
+                                        **self._constraint_hooks())
+
+    def superstep_program(self, k: int, donate: bool = False):
+        """The fused K-iteration superstep
+        (``run.Experiment.superstep_program``) under the mesh: the same
+        constraint hooks pin every value the scan carries across
+        sub-iterations — env lanes / replay episodes stay sharded on the
+        data axis, learner state replicated (grads psum'd by GSPMD) — so
+        one executable serves every dispatch, exactly like
+        ``jitted_programs``."""
+        return self.exp.superstep_program(k, donate=donate,
+                                          **self._constraint_hooks())
+
+    def _constraint_hooks(self):
+        """The shared ``constrain_*`` kwargs: one source for the canonical
+        placement of every value the driver loop (or the superstep scan)
+        chains back in."""
         data = NamedSharding(self.mesh, P(self.axis))
         rep = NamedSharding(self.mesh, P())
         wsc = jax.lax.with_sharding_constraint
@@ -163,10 +181,9 @@ class DataParallel:
                 priorities=wsc(buf.priorities, rep),
                 max_priority=wsc(buf.max_priority, rep))
 
-        return self.exp.jitted_programs(
+        return dict(
             constrain_batch=lambda b: wsc(b, data),
             constrain_runner=constrain_runner,
             constrain_buffer=constrain_buffer,
             constrain_learner=lambda l: jax.tree.map(
-                lambda x: wsc(x, rep), l),
-            donate=donate)
+                lambda x: wsc(x, rep), l))

@@ -54,6 +54,26 @@ class TrainState:
     episode: jnp.ndarray      # () int32 — episodes collected
 
 
+def superstep_eligible(cfg: TrainConfig) -> bool:
+    """Whether the fused K-iteration superstep program serves this config
+    (the ``ops/query_slice.py`` eligibility-predicate pattern): K > 1
+    requested AND the replay ring is device-resident — the host-RAM
+    buffer's insert/sample are host calls and cannot live inside one XLA
+    program, so ``buffer_cpu_only`` configs keep the classic
+    three-program path at any ``superstep`` value."""
+    return cfg.superstep > 1 and not cfg.replay.buffer_cpu_only
+
+
+def _strong(tree):
+    """Drop weak_type from every chained output: the driver feeds these
+    back as inputs, and a weak-typed leaf (e.g. from a Python-scalar
+    jnp.where branch) makes the output aval differ from the strong input
+    aval — forcing a silent second compile of the whole program on loop
+    iteration 2. astype(same-dtype) is a no-op in XLA but strips the
+    weak flag."""
+    return jax.tree.map(lambda x: x.astype(x.dtype), tree)
+
+
 @dataclasses.dataclass
 class Experiment:
     """Built components + jitted programs for one config."""
@@ -184,15 +204,6 @@ class Experiment:
         c_buffer = constrain_buffer or (lambda b: b)
         c_learner = constrain_learner or (lambda l: l)
 
-        def _strong(tree):
-            """Drop weak_type from every chained output: the driver feeds
-            these back as inputs, and a weak-typed leaf (e.g. from a
-            Python-scalar jnp.where branch) makes the output aval differ
-            from the strong input aval — forcing a silent second compile
-            of the whole program on loop iteration 2. astype(same-dtype)
-            is a no-op in XLA but strips the weak flag."""
-            return jax.tree.map(lambda x: x.astype(x.dtype), tree)
-
         def _rollout(params, rs, test_mode):
             rs2, batch, stats = runner.run(params, rs, test_mode=test_mode)
             return _strong(c_runner(rs2)), constrain(batch), stats
@@ -211,18 +222,19 @@ class Experiment:
             def train_iter_host(ts: TrainState, key: jax.Array,
                                 t_env: jnp.ndarray):
                 # host RNG owns sampling; key seeds noise/dropout (train
-                # ignores it for pure configs)
+                # ignores it for pure configs). sample() first consumes
+                # the PREVIOUS iteration's deferred priority feedback —
+                # the |TD| / finite-flag fetch is started asynchronously
+                # below and never blocks this iteration (one ~0.66 s
+                # tunnel round-trip per train iter removed, BASELINE.md);
+                # the non-finite guard moves into the flush (a tripped
+                # step still leaves the sum-tree untouched)
                 batch, idx, weights = buffer.sample(cfg.batch_size,
                                                     int(t_env))
                 learner_state, info = train(ts.learner, batch, weights,
                                             t_env, ts.episode, key)
-                # non-finite guard: the priority fetch below already
-                # blocks (host path is synchronous), so the flag fetch
-                # costs nothing extra; a tripped step leaves the sum-tree
-                # untouched (NaN priorities would corrupt it permanently)
-                td = jax.device_get(info["td_errors_abs"])
-                if bool(jax.device_get(info["all_finite"])):
-                    buffer.update_priorities(idx, td + 1e-6)
+                buffer.defer_priority_update(idx, info["td_errors_abs"],
+                                             info["all_finite"])
                 return ts.replace(learner=learner_state), info
 
             return rollout, insert, train_iter_host
@@ -255,6 +267,103 @@ class Experiment:
 
         return rollout, insert, jax.jit(
             _train_iter, donate_argnums=(0,) if donate else ())
+
+    def superstep_program(self, k: int, constrain_batch=None,
+                          constrain_runner=None, constrain_buffer=None,
+                          constrain_learner=None, donate: bool = False):
+        """→ jitted ``superstep(ts, keys, t_env0) -> (ts', stacked_stats,
+        stacked_infos)`` — the Anakin/Podracer fusion (PAPERS.md): rollout
+        → in-place ring insert → gate-checked sample+train as ONE XLA
+        program, ``lax.scan``-ed ``k`` iterations per dispatch.
+
+        Amortizes the per-dispatch overhead (~0.66 s under the axon
+        tunnel, BASELINE.md) over ``k`` full train iterations, and never
+        materializes the ``(B, T+1, ...)`` episode batch between rollout
+        and insert: the rollout scan's time-major emission scatters
+        straight into the (donated → in-place) replay ring
+        (``ReplayBuffer.insert_time_major``).
+
+        Contract with the classic three-program loop (pinned by
+        tests/test_superstep.py):
+
+        * the train gate ``episodes_in_buffer >= batch_size AND episode
+          >= accumulated_episodes`` is traced arithmetic on the carried
+          counters — a ``lax.cond``, so skipped sub-iterations pay no
+          train compute;
+        * ``keys`` is the ``(k, key)`` stack of per-sub-iteration train
+          keys. The driver splits its key stream ONLY for sub-iterations
+          whose gate fires (it mirrors the counters host-side, exactly
+          like the classic loop's host gate) and passes zeros for skipped
+          rows, so the consumed key stream — and therefore training — is
+          bit-identical to the K=1 loop;
+        * epsilon/beta schedules thread through as functions of the
+          carried ``t_env``: sub-iteration ``i`` trains at ``t_env0 +
+          (i+1)·B·T``, matching the host counter the classic loop passes;
+        * ``stacked_stats``/``stacked_infos`` come back shaped ``(k,
+          ...)`` and feed the host accumulators once per dispatch; info
+          rows of skipped sub-iterations are aval-matched zeros with
+          ``all_finite=True`` (``QMixLearner.train_info_zeros``) and are
+          dropped by the driver via its host gate mirror.
+
+        ``donate=True`` donates the full TrainState — ring, learner and
+        runner state update in place across the superstep. Host-RAM
+        replay configs are ineligible (``superstep_eligible``)."""
+        if self.host_buffer:
+            raise ValueError(
+                "superstep_program requires the device-resident replay "
+                "ring; buffer_cpu_only configs use the three-program "
+                "path (superstep_eligible)")
+        if k < 1:
+            raise ValueError(f"superstep k must be >= 1, got {k}")
+        runner, buffer, learner, cfg = (self.runner, self.buffer,
+                                        self.learner, self.cfg)
+        constrain = constrain_batch or (lambda b: b)
+        c_runner = constrain_runner or (lambda rs: rs)
+        c_buffer = constrain_buffer or (lambda b: b)
+        c_learner = constrain_learner or (lambda l: l)
+        steps_per_rollout = cfg.batch_size_run * cfg.env_args.episode_limit
+
+        def _train(op):
+            ts, key, t_env = op
+            # identical key/arithmetic threading to _train_iter above
+            k_sample, k_learn = jax.random.split(key)
+            batch, idx, weights = buffer.sample(
+                ts.buffer, k_sample, cfg.batch_size, t_env)
+            learner_state, info = learner.train(
+                ts.learner, constrain(batch), weights, t_env, ts.episode,
+                k_learn)
+            prio = jnp.where(info["all_finite"],
+                             info["td_errors_abs"] + 1e-6,     # Q9
+                             ts.buffer.priorities[idx])
+            buf = buffer.update_priorities(ts.buffer, idx, prio)
+            return ts.replace(learner=c_learner(learner_state),
+                              buffer=c_buffer(buf)), info
+
+        def _skip(op):
+            ts, _, _ = op
+            return ts, learner.train_info_zeros(cfg.batch_size)
+
+        def _body(ts: TrainState, xs):
+            key, t_env = xs
+            rs, tm, stats = runner.run_raw(ts.learner.params["agent"],
+                                           ts.runner, test_mode=False)
+            buf = buffer.insert_time_major(ts.buffer, tm)
+            ts = ts.replace(runner=c_runner(rs), buffer=c_buffer(buf),
+                            episode=ts.episode + cfg.batch_size_run)
+            gate = (buffer.can_sample(ts.buffer, cfg.batch_size)
+                    & (ts.episode >= cfg.accumulated_episodes))
+            ts, info = jax.lax.cond(gate, _train, _skip, (ts, key, t_env))
+            return _strong(ts), (stats, _strong(info))
+
+        def _superstep(ts: TrainState, keys: jax.Array,
+                       t_env0: jnp.ndarray):
+            t_envs = (jnp.asarray(t_env0, jnp.int32)
+                      + jnp.arange(1, k + 1, dtype=jnp.int32)
+                      * steps_per_rollout)
+            ts, (stats, infos) = jax.lax.scan(_body, ts, (keys, t_envs))
+            return ts, stats, infos
+
+        return jax.jit(_superstep, donate_argnums=(0,) if donate else ())
 
 
 def run(cfg: TrainConfig, logger: Optional[Logger] = None) -> TrainState:
@@ -314,6 +423,18 @@ def run_sequential(exp: Experiment, logger: Logger,
     # the driver loop replaces its state right after every call, so the
     # replay ring / train state can be donated (in-place on device)
     rollout, insert, train_iter = (dp or exp).jitted_programs(donate=True)
+    # fused superstep (config.superstep, docs/SPEC.md §8): K > 1 swaps the
+    # three-program iteration for ONE donated program scanning K rollout→
+    # insert→train iterations per dispatch; the rollout program above
+    # still serves the test/animation cadences
+    K = cfg.superstep if superstep_eligible(cfg) else 1
+    superstep = ((dp or exp).superstep_program(K, donate=True)
+                 if K > 1 else None)
+    if cfg.superstep > 1 and K == 1:
+        log.info("superstep requested but ineligible (buffer_cpu_only "
+                 "keeps the three-program path)")
+    elif K > 1:
+        log.info(f"fused superstep: {K} iterations per dispatch")
     key = jax.random.PRNGKey(cfg.seed + 1)
 
     t_env = 0
@@ -397,45 +518,85 @@ def run_sequential(exp: Experiment, logger: Logger,
             # fault-injection hook + preemption poll (docs/RESILIENCE.md):
             # the signal handler only sets a flag; the orderly exit —
             # emergency checkpoint, resume hint, exit 0 — happens here, at an
-            # iteration boundary where ts is a complete consistent state
+            # iteration boundary where ts is a complete consistent state.
+            # Under superstep K>1 this is a DISPATCH boundary: the poll,
+            # every cadence, and every checkpoint land between fused
+            # dispatches, so a preemption loses at most K iterations and a
+            # restored checkpoint always resumes at a K-aligned t_env
             resilience.fire("driver.iteration", t_env=t_env, guard=guard)
             if guard.triggered:
                 break
             tracer.maybe_start(t_env)
-            # ---------------- rollout (no grad by construction) ----------------
-            with timer.stage("rollout"):
-                rs, batch, stats = rollout(ts.learner.params["agent"], ts.runner,
-                                           test_mode=False)
-                ts = ts.replace(runner=rs,
-                                buffer=insert(ts.buffer, batch),
-                                episode=ts.episode + cfg.batch_size_run)
-                if sync_stages:
-                    jax.block_until_ready(rs.t_env)
-            t_env += steps_per_rollout
-            episode += cfg.batch_size_run
-            buffer_filled = min(buffer_filled + cfg.batch_size_run,
-                                buffer_capacity)
+            if K > 1:
+                # ------------ fused superstep (one dispatch = K iters) ------
+                # mirror the control scalars host-side for each of the K
+                # sub-iterations: they evolve deterministically (see the
+                # async-dispatch note above), so the host knows exactly
+                # which sub-iterations train — it splits the driver key
+                # stream ONLY for those (bit-identical threading to the
+                # K=1 loop's conditional split) and keeps their stacked
+                # info rows, dropping the zero rows of skipped ones
+                key_rows, gated = [], []
+                for _ in range(K):
+                    episode += cfg.batch_size_run
+                    buffer_filled = min(buffer_filled + cfg.batch_size_run,
+                                        buffer_capacity)
+                    g = (buffer_filled >= cfg.batch_size
+                         and episode >= cfg.accumulated_episodes)
+                    gated.append(g)
+                    if g:
+                        key, k_sample = jax.random.split(key)
+                        key_rows.append(k_sample)
+                    else:
+                        key_rows.append(jnp.zeros_like(key))
+                with timer.stage("superstep"):
+                    ts, stats, infos = superstep(ts, jnp.stack(key_rows),
+                                                 jnp.asarray(t_env))
+                    if sync_stages:
+                        jax.block_until_ready(stats.epsilon)
+                t_env += K * steps_per_rollout
+                for i, g in enumerate(gated):
+                    if g:
+                        train_infos.append(
+                            jax.tree.map(lambda x, i=i: x[i], infos))
+            else:
+                # ------------ rollout (no grad by construction) -------------
+                with timer.stage("rollout"):
+                    rs, batch, stats = rollout(ts.learner.params["agent"],
+                                               ts.runner, test_mode=False)
+                    ts = ts.replace(runner=rs,
+                                    buffer=insert(ts.buffer, batch),
+                                    episode=ts.episode + cfg.batch_size_run)
+                    if sync_stages:
+                        jax.block_until_ready(rs.t_env)
+                t_env += steps_per_rollout
+                episode += cfg.batch_size_run
+                buffer_filled = min(buffer_filled + cfg.batch_size_run,
+                                    buffer_capacity)
+
+                # ------------ train gate (reference :220-238) ---------------
+                if exp.host_buffer:
+                    can = exp.buffer.can_sample(cfg.batch_size)
+                else:
+                    can = buffer_filled >= cfg.batch_size
+                if can and episode >= cfg.accumulated_episodes:
+                    key, k_sample = jax.random.split(key)
+                    with timer.stage("train"):
+                        ts, info = train_iter(ts, k_sample,
+                                              jnp.asarray(t_env))
+                        if sync_stages:
+                            jax.block_until_ready(info["loss"])
+                    train_infos.append(info)
+            # shared accounting for both loop shapes: ONE stats push per
+            # dispatch (per-rollout (B,) or stacked (K, B) — the
+            # accumulator flattens), then the dispatch run-ahead bound:
+            # block on the dispatch from two back (TPU executes in
+            # dispatch order, so this caps live episode batches while
+            # still double-buffering host↔device)
             train_acc.push(stats)
-            # bound the dispatch run-ahead: block on the rollout from two
-            # iterations back (TPU executes in dispatch order, so this caps
-            # live episode batches at ~3 while still double-buffering
-            # host↔device)
             inflight.append(stats.epsilon)
             if len(inflight) > 2:
                 jax.block_until_ready(inflight.popleft())
-
-            # ---------------- train gate (reference :220-238) ------------------
-            if exp.host_buffer:
-                can = exp.buffer.can_sample(cfg.batch_size)
-            else:
-                can = buffer_filled >= cfg.batch_size
-            if can and episode >= cfg.accumulated_episodes:
-                key, k_sample = jax.random.split(key)
-                with timer.stage("train"):
-                    ts, info = train_iter(ts, k_sample, jnp.asarray(t_env))
-                    if sync_stages:
-                        jax.block_until_ready(info["loss"])
-                train_infos.append(info)
             tracer.tick(logger)
 
             # train-stat cadence: runner_log_interval, epsilon alongside

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from ..components.episode_buffer import CompactEntityObs, EpisodeBatch
+from ..components.episode_buffer import CompactEntityObs, TimeMajorEpisodes
 from ..config import TrainConfig
 from ..controllers.basic_mac import BasicMAC
 from ..envs.mec_offload import EnvState, MultiAgvOffloadingEnv
@@ -131,6 +131,21 @@ class ParallelRunner:
         visualization fields (pre-step AGV positions, serving MECs, ACKs) as
         ``(T, B, ...)`` arrays — the same scan emits them, so the trajectory
         is exactly the episode in the returned batch (no re-run, no drift)."""
+        out = self.run_raw(params, rs, test_mode=test_mode, capture=capture)
+        if capture:
+            new_rs, tm, stats, viz = out
+            return new_rs, tm.to_batch(), stats, viz
+        new_rs, tm, stats = out
+        return new_rs, tm.to_batch(), stats
+
+    def run_raw(self, params, rs: RunnerState, test_mode: bool = False,
+                capture: bool = False):
+        """``run`` minus the episode-batch assembly: returns the scan's
+        time-major emission (``TimeMajorEpisodes``) so the fused superstep
+        can scatter it straight into the replay ring without ever
+        materializing the ``(B, T+1, ...)`` batch. ``run`` itself is
+        ``run_raw`` + ``to_batch()`` — one rollout definition for both
+        paths."""
         b, t_len = self.batch_size, self.env.cfg.episode_limit
         key, k_reset, k_scan = jax.random.split(rs.key, 3)
         # qslice weight folds are loop-invariant: do them once per rollout,
@@ -212,31 +227,27 @@ class ParallelRunner:
         (pre, reward, rec_reward, env_terminal, info, eps, viz_seq) = ys
         obs_seq, gstate_seq, avail_seq, action_seq = pre
 
-        # (T, B, ...) → (B, T, ...), with the bootstrap step appended
-        bt = lambda x: jnp.swapaxes(x, 0, 1)
-        cat_last = lambda seq, last: jax.tree.map(
-            lambda s, l: jnp.concatenate([bt(s), l[:, None]], axis=1),
-            seq, last)
-
         if compact_store:
             last_obs_store = obs_store(
                 env_states, last_obs,
                 jax.vmap(self.env.compact_obs)(env_states))
         else:
             last_obs_store = last_obs.astype(sd)
-        batch = EpisodeBatch(
-            obs=cat_last(obs_seq, last_obs_store),
-            state=cat_last(gstate_seq, last_gstate.astype(sd)),
-            avail_actions=cat_last(avail_seq, last_avail > 0),
-            actions=bt(action_seq),
-            reward=bt(rec_reward),   # scaled under reward_scaling; else raw
-            terminated=bt(env_terminal),
-            filled=jnp.ones((b, t_len), bool),
+        tm = TimeMajorEpisodes(
+            obs=obs_seq,
+            state=gstate_seq,
+            avail_actions=avail_seq,
+            actions=action_seq,
+            reward=rec_reward,       # scaled under reward_scaling; else raw
+            terminated=env_terminal,
+            last_obs=last_obs_store,
+            last_state=last_gstate.astype(sd),
+            last_avail=last_avail > 0,
         )
 
-        last = lambda x: bt(x)[:, -1]      # terminal-step info values
+        last = lambda x: x[-1]             # terminal-step info values
         stats = RolloutStats(
-            episode_return=bt(reward).sum(axis=1),
+            episode_return=reward.sum(axis=0),
             episode_length=jnp.full((b,), t_len, jnp.float32),
             reward=last(reward),
             delay_reward=last(info.delay_reward),
@@ -254,5 +265,5 @@ class ParallelRunner:
             pos_seq, mec_seq, ack_seq = viz_seq
             viz = {"pos": pos_seq, "mec_index": mec_seq, "acks": ack_seq,
                    "actions": action_seq, "reward": reward, "info": info}
-            return new_rs, batch, stats, viz
-        return new_rs, batch, stats
+            return new_rs, tm, stats, viz
+        return new_rs, tm, stats

@@ -69,17 +69,23 @@ class StatsAccumulator:
 
     def _fold(self) -> None:
         """Fetch every pending device ref (ONE host round-trip) and fold
-        it into the host-side sums; clears ``_pending``."""
+        it into the host-side sums; clears ``_pending``. A pushed stats
+        object may be one rollout's ``(B,)`` arrays or a fused
+        superstep's stacked ``(K, B)`` — flattening makes both the same
+        per-episode stream (the episode count in ``push`` already used
+        the full shape product)."""
         if not self._pending:
             return
         fetched = jax.device_get(self._pending)
         for s in fetched:
-            ret = np.atleast_1d(np.asarray(s.episode_return))
+            ret = np.asarray(s.episode_return).reshape(-1)
             self._returns.extend(float(x) for x in ret)
             for k in TERMINAL_INFO_KEYS:
                 self._stats[k] += float(np.sum(getattr(s, k)))
-        # the last pending entry owns the epsilon ref — same fetch
-        self._eps_val = float(np.mean(np.asarray(fetched[-1].epsilon)))
+        # the last pending entry owns the epsilon ref — same fetch; a
+        # stacked push's most recent value is its LAST row
+        self._eps_val = float(np.mean(
+            np.asarray(fetched[-1].epsilon).reshape(-1)[-1:]))
         self._eps_ref = None
         self._pending.clear()
 
@@ -94,8 +100,9 @@ class StatsAccumulator:
         ``flush`` refreshes the cached value inside its own single fetch,
         which is where cadenced callers should get it."""
         if self._eps_ref is not None:
-            self._eps_val = float(np.mean(np.asarray(
-                jax.device_get(self._eps_ref))))
+            # a stacked (K,) superstep push reports its LAST sub-iteration
+            self._eps_val = float(np.asarray(
+                jax.device_get(self._eps_ref)).reshape(-1)[-1])
             self._eps_ref = None
         return self._eps_val
 

@@ -26,6 +26,8 @@ def run_bench(*args):
     return json.loads(lines[0])
 
 
+@pytest.mark.slow   # subprocess + fresh jit (~30 s); the round driver
+                    # runs `bench.py --smoke` directly anyway
 def test_default_line_schema():
     rec = run_bench()
     for k in ("metric", "value", "unit", "vs_baseline"):
@@ -37,6 +39,7 @@ def test_default_line_schema():
     assert rec["config"] is None
 
 
+@pytest.mark.slow   # two subprocess benches; the acting flag plumbing is pure argparse
 @pytest.mark.parametrize("acting", ["qslice", "dense"])
 def test_acting_selector_reported(acting):
     rec = run_bench("--acting", acting)
@@ -44,6 +47,7 @@ def test_acting_selector_reported(acting):
     assert rec["value"] > 0
 
 
+@pytest.mark.slow   # subprocess + fresh jit; rbg impl pinned cheaply in test_driver
 def test_prng_rbg_end_to_end():
     """--prng rbg routes every key through the XLA RngBitGenerator (the
     TPU-hardware path; subprocess keeps the process-global impl switch
@@ -54,6 +58,7 @@ def test_prng_rbg_end_to_end():
     assert rec["prng"] == "rbg"
 
 
+@pytest.mark.slow   # subprocess + fresh jit; --pipeline plumbing only
 def test_pipeline_flag_adds_steady_state_rate():
     rec = run_bench("--pipeline", "2")
     assert rec["pipelined_env_steps_per_sec"] > 0
@@ -61,6 +66,7 @@ def test_pipeline_flag_adds_steady_state_rate():
     assert rec["metric"] == "env_steps_per_sec" and rec["value"] > 0
 
 
+@pytest.mark.slow   # subprocess + train compile; pipeline flag covered by the rollout variant
 def test_pipeline_train_steady_state():
     rec = run_bench("--train", "--pipeline", "2")
     assert rec["pipelined_train_steps_per_sec"] > 0
@@ -86,8 +92,10 @@ def test_committed_config_presets_load():
 
 def test_backend_probe_bound_emits_record():
     """A wedged TPU tunnel blocks backend init far past the caller's own
-    timeout — the probe bound must land a parseable error record first
-    (probe timeout <= 0 forces the timed-out branch deterministically)."""
+    timeout — the bounded SUBPROCESS probe must land a parseable,
+    structured error record first (probe timeout <= 0 forces the
+    timed-out branch deterministically; the retry must show in the
+    message)."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
@@ -98,7 +106,22 @@ def test_backend_probe_bound_emits_record():
     assert proc.returncode == 1
     rec = json.loads(proc.stdout.strip().splitlines()[-1])
     assert rec["value"] is None
+    assert rec["phase"] == "backend_init"
     assert "probe bound" in rec["error"]
+    assert "attempt 2/2" in rec["error"]            # one retry happened
+
+
+def test_superstep_bench_reports_amortized_rate():
+    """--superstep K: the fused-dispatch measurement. K=4 exercises the
+    scan and the warm dispatch must have opened the train gate; the K=1
+    leg (same code path, k-independent) rides the round driver's
+    acceptance run of `bench.py --smoke --superstep 1`."""
+    rec = run_bench("--superstep", "4")
+    assert rec["metric"] == "env_steps_per_sec"
+    assert isinstance(rec["value"], (int, float)) and rec["value"] > 0
+    assert rec["superstep"] == 4
+    assert rec["train_gate_open"] is True
+    assert rec["config"] is None
 
 
 def test_hbm_estimator_schema_and_no_device_work():
@@ -120,6 +143,7 @@ def test_hbm_estimator_schema_and_no_device_work():
         "learner_scan_residuals"}
 
 
+@pytest.mark.slow   # DP=8 allocation + train compile (~2 min on the 2-core box)
 def test_prod_hbm_allocates_ring_and_cross_checks_analytic():
     """--prod-hbm (VERDICT r4 item 4 producer): PRODUCTION-shaped ring
     (agv 256 / emb 256 / bf16 compact storage) actually allocated on the
@@ -148,6 +172,7 @@ def test_prod_hbm_allocates_ring_and_cross_checks_analytic():
     assert math.isfinite(rec["train_loss"])
 
 
+@pytest.mark.slow   # 8-virtual-device mesh compile (~3 min on the 2-core box)
 def test_dp_bench_path_on_virtual_mesh():
     """The --config 5 (DP=8) bench is the config-5 round-artifact
     producer: run it at reduced shapes on the 8-device virtual CPU mesh

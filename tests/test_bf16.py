@@ -28,6 +28,7 @@ def bf16_exp():
     return Experiment.build(cfg)
 
 
+@pytest.mark.slow   # bf16 rollout compile (~21 s); the bf16 train-step e2e stays in-gate
 def test_bf16_rollout_storage_and_boundaries(bf16_exp):
     exp = bf16_exp
     ts = exp.init_train_state(0)
@@ -44,6 +45,7 @@ def test_bf16_rollout_storage_and_boundaries(bf16_exp):
     assert np.isfinite(np.asarray(stats.episode_return)).all()
 
 
+@pytest.mark.slow   # bf16 train compile (~16 s); the f32-boundary forward test stays in-gate
 def test_bf16_end_to_end_train_step(bf16_exp):
     exp = bf16_exp
     cfg = exp.cfg

@@ -67,6 +67,7 @@ def test_run_sequential_end_to_end(tmp_path):
     assert dirs and all(os.path.basename(d).isdigit() for d in dirs)
 
 
+@pytest.mark.slow   # two full run() loops (~50 s); resume-through-restore also hit by test_resilience nan-recovery
 def test_checkpoint_resume_restores_cursor_q13(tmp_path):
     cfg = tiny_cfg(tmp_path)
     ts1 = run(cfg, Logger())
@@ -96,6 +97,7 @@ def test_checkpoint_resume_restores_cursor_q13(tmp_path):
     assert all(np.isfinite(np.asarray(x)).all() for x in leaves_r)
 
 
+@pytest.mark.slow   # full run() for checkpoints; nearest-match logic pinned cheaply in test_resilience
 def test_load_step_nearest_match(tmp_path):
     cfg = tiny_cfg(tmp_path)
     run(cfg, Logger())
@@ -118,6 +120,7 @@ def test_host_buffer_branch_end_to_end(tmp_path):
     assert "loss" in keys
 
 
+@pytest.mark.slow   # two full DP run() loops (~70 s); DP program coverage stays in test_parallel
 def test_dp_devices_drives_training_from_config_alone(tmp_path):
     """dp_devices=8 through the real ``run()`` loop on the virtual 8-mesh:
     the production driver trains data-parallel with no code beyond the
@@ -344,6 +347,7 @@ def test_checkpoint_layout_mismatch_names_the_flag(tmp_path):
         load_checkpoint(d, exp_dense.init_train_state(0))
 
 
+@pytest.mark.slow   # DP run() + two restore paths (~50 s)
 def test_dp_checkpoint_evaluates_under_other_configs(tmp_path):
     """A checkpoint from a DP=8 run must drive evaluation under a
     different config (fewer env lanes, no mesh): the full-state restore
@@ -396,6 +400,7 @@ def test_model_only_restore_rejects_different_model(tmp_path):
         load_learner_state(d, exp_big.init_train_state(0))
 
 
+@pytest.mark.slow   # full run() under the profiler (~60 s)
 def test_profile_dir_produces_a_trace(tmp_path):
     """A1 evidence: profile_dir wires a jax.profiler trace window over the
     hot loop — the trace files must actually land on disk."""

@@ -114,6 +114,7 @@ def test_host_buffer_bf16_storage():
     assert batch.obs.dtype == jnp.bfloat16
 
 
+@pytest.mark.slow   # full train compile (~21 s); the driver host-buffer e2e stays in-gate (test_driver)
 def test_host_buffer_end_to_end_training():
     """Full driver loop with buffer_cpu_only=True (native sum-tree path)."""
     from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,

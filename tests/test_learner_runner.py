@@ -226,6 +226,7 @@ def test_noisy_sigma_params_receive_gradient(noisy_setup):
         assert np.abs(g).max() > 0, f"{name} gradient is zero"
 
 
+@pytest.mark.slow   # full build for an error-path assertion
 def test_noisy_train_requires_key(noisy_setup):
     cfg, learner, ls, batch = noisy_setup
     w = jnp.ones((cfg.batch_size_run,))
@@ -261,6 +262,7 @@ def test_mixer_monotonic_in_agent_qs(setup):
     assert (np.asarray(g) >= 0).all()
 
 
+@pytest.mark.slow   # remat'd + plain backward compiles (~12 s)
 def test_remat_is_exact(setup):
     """model.remat recomputes forwards in the backward pass — a
     memory/compute trade, not an approximation: the loss is identical and
@@ -284,6 +286,7 @@ def test_remat_is_exact(setup):
         np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4), g0, g1)
 
 
+@pytest.mark.slow   # noisy remat backward compile
 def test_remat_noisy_path_gradients_flow(noisy_setup):
     """remat wraps the rng-driven scan bodies too (noisy/dropout unrolls
     carry per-step keys): gradients must stay finite and sigma params

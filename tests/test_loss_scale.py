@@ -77,6 +77,7 @@ def test_levers_off_by_default():
     assert cfg.reward_unit == 1.0
 
 
+@pytest.mark.slow   # huge-delta recompile (~12 s); the gradient-bound huber test stays in-gate
 def test_huber_inf_delta_matches_mse(setup):
     cfg, learner, ls, sample, w = setup
     l_mse, g_mse, grads_mse = _loss_and_grads(learner, ls, sample, w)

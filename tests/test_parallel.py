@@ -46,6 +46,7 @@ def test_divisibility_guard():
         DataParallel(exp, make_mesh(8))
 
 
+@pytest.mark.slow   # double init + device_put of the ring (~30 s incl. fixture)
 def test_init_sharded_equals_shard_of_init(dp_setup):
     """dp.init_sharded builds the state BORN sharded (jit out_shardings —
     no single-device full-ring transient at startup); it must be
@@ -69,6 +70,7 @@ def test_init_sharded_equals_shard_of_init(dp_setup):
                 rtol=1e-6, atol=1e-3, err_msg=k)
 
 
+@pytest.mark.slow   # DP program compiles (~20 s); the chained-compile test keeps mesh coverage in-gate
 def test_sharded_rollout_and_train_step(dp_setup):
     cfg, exp, dp, ts = dp_setup
     rollout, insert, train_iter = dp.jitted_programs()
@@ -115,6 +117,7 @@ def test_dp_chained_programs_compile_exactly_once(dp_setup):
     assert train_iter._cache_size() == 1
 
 
+@pytest.mark.slow   # single-device + DP train compiles (~26 s)
 def test_dp_matches_single_device_loss(dp_setup):
     """The sharded loss equals the unsharded loss on identical inputs —
     the DP axis is arithmetic-neutral."""

@@ -276,6 +276,7 @@ def test_prefolded_params_match_unfolded():
     np.testing.assert_array_equal(h_f, h_raw)
 
 
+@pytest.mark.slow   # full T-step learner unroll both paths (~45 s); forward+grad equivalence pinned above
 def test_learner_loss_matches_dense_path():
     """End-to-end: the learner's loss/priorities with qslice unrolls match
     the dense-path learner bit-for-tolerance on the same batch."""

@@ -80,6 +80,7 @@ def test_find_checkpoint_empty_and_missing_dir(tmp_path):
     assert find_checkpoint(str(empty)) is None
 
 
+@pytest.mark.slow   # Experiment build + real checkpoints for a listdir edge case
 def test_find_checkpoint_ignores_non_numeric_entries(tmp_path):
     root, _, _ = _save_steps(tmp_path, [10])
     os.makedirs(os.path.join(root, "tb_logs"))
@@ -275,6 +276,7 @@ def test_nan_injection_recovers_end_to_end(tmp_path):
 
 
 @pytest.mark.faultinject
+@pytest.mark.slow   # full run() to an abort; the recover-with-checkpoint path stays in-gate
 def test_nan_without_checkpoint_aborts_with_diagnosis(tmp_path):
     cfg = tiny_cfg(tmp_path, save_model=False,
                    res_kw=dict(inject_nan_at_step=0, nonfinite_tolerance=1))
@@ -297,6 +299,7 @@ def test_shutdown_guard_latches_real_signal():
 
 
 @pytest.mark.faultinject
+@pytest.mark.slow   # full run() (~22 s); same guard path runs in-gate at K>1 in test_superstep
 def test_sigterm_writes_emergency_checkpoint_and_returns(tmp_path):
     """A real SIGTERM mid-run: the loop breaks at the next iteration
     boundary, writes one emergency checkpoint, and returns normally (the
@@ -393,6 +396,7 @@ def test_resilience_config_sanity_and_overrides():
     assert cfg.resilience.nonfinite_tolerance == 7
 
 
+@pytest.mark.slow   # full run() with pruning (~24 s); prune_checkpoints logic pinned directly above
 def test_retention_runs_inside_driver(tmp_path):
     """keep_last wired through run_sequential: after training, at most
     keep_last checkpoints remain on disk."""

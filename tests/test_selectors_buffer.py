@@ -155,6 +155,7 @@ def test_per_new_episodes_get_max_priority():
     assert float(s.priorities[2]) == pytest.approx(5.0)   # running max
 
 
+@pytest.mark.slow   # full rollout compile (~19 s) for a dtype assertion
 def test_avail_actions_storage_is_bool():
     """avail is a predicate: bool ring storage makes arithmetic misuse a
     type error (consumers only ever compare > 0)."""
