@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# graftlint gate — JAX tracing-hygiene static analysis over t2omca_tpu/
+# (rule catalog: docs/ANALYSIS.md; accepted findings + justifications:
+# t2omca_tpu/analysis/baseline.json). Exit 0: no new findings; exit 1:
+# new findings, each printed as path:line:col RULE message. Pure AST —
+# no jax import, no backend startup — so it runs in front of the tier-1
+# pytest batch (scripts/t1.sh) at negligible cost.
+cd "$(dirname "$0")/.." || exit 2
+python -m t2omca_tpu.analysis "$@"

@@ -2,4 +2,4 @@
 # Tier-1 verify gate — the ROADMAP.md command, verbatim. Run from the repo
 # root: `bash scripts/t1.sh`. Prints DOTS_PASSED=<n> and exits with
 # pytest's status.
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; bash "$(dirname "$0")/lint.sh"; lrc=$?; [ $lrc -ne 0 ] && { [ $lrc -eq 1 ] && echo "graftlint gate failed (new findings above; docs/ANALYSIS.md)" || echo "graftlint internal error (exit $lrc; docs/ANALYSIS.md)"; exit 1; }; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,0 +1,40 @@
+"""Static analysis + runtime enforcement for JAX tracing hygiene.
+
+Two halves (docs/ANALYSIS.md):
+
+* ``graftlint`` — AST lint over the package for JAX-specific hazards
+  (tracer branching, host calls in traced code, unrolled-scan smells,
+  hot-path host syncs, donation aliasing, dead imports), ratcheted by
+  the checked-in ``baseline.json``. CLI: ``python -m t2omca_tpu.analysis``
+  (``scripts/lint.sh``; runs at the top of the tier-1 gate).
+* ``guards`` — runtime context managers tests assert under:
+  ``compile_budget(n)`` pins a program to n XLA compiles,
+  ``no_transfer()`` turns implicit host transfers into errors.
+
+``guards`` imports jax; the lint CLI must stay import-light (it runs in
+front of every test batch), so guard names resolve lazily via module
+``__getattr__`` instead of an eager import.
+"""
+
+from __future__ import annotations
+
+from .baseline import (DEFAULT_BASELINE, diff_baseline, load_baseline,
+                       save_baseline)
+from .graftlint import (HOT_PATH_GLOBS, RULES, Finding, lint_file,
+                        lint_package, lint_source)
+
+_GUARD_NAMES = ("compile_budget", "no_transfer", "CompileBudgetExceeded",
+                "CompileEvents")
+
+__all__ = [
+    "DEFAULT_BASELINE", "diff_baseline", "load_baseline", "save_baseline",
+    "HOT_PATH_GLOBS", "RULES", "Finding", "lint_file", "lint_package",
+    "lint_source", *_GUARD_NAMES,
+]
+
+
+def __getattr__(name: str):
+    if name in _GUARD_NAMES:
+        from . import guards
+        return getattr(guards, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

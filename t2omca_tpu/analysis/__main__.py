@@ -1,0 +1,96 @@
+"""``python -m t2omca_tpu.analysis`` — the graftlint CLI.
+
+Exit codes (the contract ``scripts/lint.sh`` and the tier-1 gate rely
+on): 0 = no new findings (baselined accepted findings are fine),
+1 = new findings (each printed as ``path:line:col: RULE message``),
+2 = usage/internal error. Stale baseline entries are warned about but
+never fail — re-run with ``--write-baseline`` to tighten the ratchet.
+
+Deliberately jax-free: the lint pass is pure AST and runs in front of
+every test batch, so it must not pay (or depend on) backend startup.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from collections import Counter
+from pathlib import Path
+
+from .baseline import (DEFAULT_BASELINE, diff_baseline, load_baseline,
+                       save_baseline)
+from .graftlint import RULES, lint_package
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m t2omca_tpu.analysis",
+        description="graftlint: JAX tracing-hygiene static analysis "
+                    "(rule catalog: docs/ANALYSIS.md)")
+    parser.add_argument(
+        "paths", nargs="*", type=Path,
+        help="files/directories to lint (default: the t2omca_tpu package)")
+    parser.add_argument(
+        "--root", type=Path, default=None,
+        help="repo root findings are reported relative to (default: the "
+             "package's parent directory)")
+    parser.add_argument(
+        "--baseline", type=Path, default=DEFAULT_BASELINE,
+        help="accepted-findings file (default: analysis/baseline.json)")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding as new (ignore the baseline)")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept the current finding set as the baseline (keeps "
+             "existing justifications; new keys get a TODO marker)")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, summary in sorted(RULES.items()):
+            print(f"{rule}  {summary}")
+        return 0
+
+    root = args.root or Path(__file__).resolve().parents[2]
+    try:
+        findings = lint_package(root, args.paths or None)
+    except (OSError, SyntaxError, ValueError) as e:
+        print(f"graftlint: error: {e}", file=sys.stderr)
+        return 2
+
+    try:
+        baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        print(f"graftlint: error: unreadable baseline {args.baseline}: "
+              f"{e}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        save_baseline(args.baseline, findings, baseline)
+        print(f"graftlint: wrote {len(set(f.key() for f in findings))} "
+              f"accepted keys to {args.baseline}")
+        return 0
+
+    new, stale = diff_baseline(findings, baseline)
+    for f in new:
+        print(f.format())
+        print(f"    {f.code}")
+    for key in stale:
+        rule, path, code = key
+        print(f"graftlint: warning: stale baseline entry {rule} {path}: "
+              f"{code!r} (fixed? run --write-baseline to tighten)",
+              file=sys.stderr)
+    n_base = len(findings) - len(new)
+    per_rule = Counter(f.rule for f in new)
+    summary = ", ".join(f"{r}x{c}" if c > 1 else r
+                        for r, c in sorted(per_rule.items()))
+    print(f"graftlint: {len(findings)} findings "
+          f"({n_base} baselined, {len(new)} new"
+          + (f": {summary}" if summary else "") + ")")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

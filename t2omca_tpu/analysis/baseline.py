@@ -1,0 +1,94 @@
+"""Finding baseline: the accepted-findings ratchet for ``graftlint``.
+
+The driver loop *deliberately* syncs at its cadence boundaries
+(``run.run_sequential``: the stat flush, the run-ahead bound, resume),
+and the host-RAM replay buffer *is* host code — those GL105 hits are
+accepted, each with a one-line justification, in the checked-in
+``analysis/baseline.json``. CI then enforces a ratchet: pre-existing
+accepted findings never block, any NEW finding does (exit 1 from
+``python -m t2omca_tpu.analysis``; ``scripts/lint.sh``).
+
+Identity is ``Finding.key()`` = (rule, path, stripped code line) with a
+count per key — line numbers churn with every unrelated edit, quoted
+code text doesn't. When a file accrues *more* occurrences of an already
+-baselined line (say a second copy-pasted ``device_get``), the excess
+occurrences count as new.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+from typing import Dict, List, Sequence, Tuple
+
+from .graftlint import Finding
+
+BASELINE_VERSION = 1
+
+#: default checked-in location, next to this module
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+Key = Tuple[str, str, str]          # (rule, path, code)
+
+
+def load_baseline(path: Path = DEFAULT_BASELINE) -> Dict[Key, dict]:
+    """baseline.json -> {key: {"count": n, "justification": str}}.
+    A missing file is an empty baseline (fresh repos lint clean)."""
+    path = Path(path)
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path} has version {data.get('version')!r}, "
+            f"this tool reads version {BASELINE_VERSION}")
+    out: Dict[Key, dict] = {}
+    for e in data["findings"]:
+        key = (e["rule"], e["path"], e["code"])
+        out[key] = {"count": int(e.get("count", 1)),
+                    "justification": e.get("justification", "")}
+    return out
+
+
+def save_baseline(path: Path, findings: Sequence[Finding],
+                  old: Dict[Key, dict] | None = None) -> None:
+    """Write the current finding set as the new baseline, carrying over
+    justifications for keys that survive; new keys get a TODO marker so
+    review can't silently skip them."""
+    old = old or {}
+    counts = Counter(f.key() for f in findings)
+    entries = []
+    for key in sorted(counts):
+        rule, fpath, code = key
+        entries.append({
+            "rule": rule, "path": fpath, "code": code,
+            "count": counts[key],
+            "justification": old.get(key, {}).get(
+                "justification") or "TODO: justify or fix",
+        })
+    payload = {"version": BASELINE_VERSION, "findings": entries}
+    Path(path).write_text(json.dumps(payload, indent=2) + "\n")
+
+
+def diff_baseline(findings: Sequence[Finding],
+                  baseline: Dict[Key, dict]
+                  ) -> Tuple[List[Finding], List[Key]]:
+    """-> (new_findings, stale_keys).
+
+    New = occurrences beyond the baselined count for their key (the
+    first ``count`` occurrences by line number are the accepted ones).
+    Stale = baselined keys the code no longer produces — reported so the
+    baseline can be re-written tight, but never a failure by themselves.
+    """
+    by_key: Dict[Key, List[Finding]] = {}
+    for f in findings:
+        by_key.setdefault(f.key(), []).append(f)
+    new: List[Finding] = []
+    for key, fs in sorted(by_key.items()):
+        allowed = baseline.get(key, {}).get("count", 0)
+        fs = sorted(fs, key=lambda f: (f.line, f.col))
+        new.extend(fs[allowed:])
+    stale = [k for k, e in sorted(baseline.items())
+             if len(by_key.get(k, [])) < e["count"]]
+    return sorted(new, key=lambda f: (f.path, f.line, f.col)), stale

@@ -1,0 +1,561 @@
+"""graftlint — JAX tracing-hygiene static analysis over the package.
+
+The superstep work (docs/SPEC.md §8) exposed a class of bug no unit test
+catches until the program runs on a device: host syncs hiding in the hot
+loop (a blocking ``device_get`` cost ~0.66 s/iter under the axon tunnel,
+BASELINE.md), a shared zero-buffer tripping XLA's donate-twice check
+(``NormState.create``), and silent retraces that erase the
+dispatch-amortization win. Podracer/Anakin-style throughput (PAPERS.md)
+is exactly the property "one compiled program, zero host round-trips" —
+this module checks it with tooling instead of reviewer vigilance.
+
+Rules (catalog with rationale + examples: docs/ANALYSIS.md):
+
+========  ==============================================================
+GL101     Python ``if``/``while``/ternary branching on a traced value
+          inside a traced function (concretization error at trace time,
+          or a silent per-value retrace if the value is marked static).
+GL102     Host/numpy calls on traced values in traced code: ``float()``
+          / ``int()`` / ``bool()`` / ``np.*(tracer)`` / ``.item()`` /
+          ``.tolist()`` / ``jax.device_get`` — each one is a forced
+          device→host sync (or a trace-time error).
+GL103     ``random.*`` / ``np.random.*`` inside traced code: host RNG is
+          invisible to tracing — the draw is baked in at trace time as a
+          constant, silently reused by every later call.
+GL104     ``jnp``/``lax`` ops inside a Python ``for`` loop in traced
+          code: the loop unrolls into the XLA graph (compile time scales
+          with trip count) — the unrolled-scan smell; use ``lax.scan``.
+GL105     ``jax.device_get`` / ``block_until_ready`` in a hot-path
+          module (driver loop, learner, replay, runners): every one is a
+          potential pipeline stall; each accepted use carries a baseline
+          justification.
+GL106     ``time.*`` / ``datetime.*`` in traced code: trace-time
+          nondeterminism baked into the compiled program as a constant.
+GL107     One allocation passed to two or more fields of a single
+          constructor call (the ``NormState.create`` shared-zeros bug:
+          donating a state whose leaves alias one buffer trips XLA's
+          "donate the same buffer twice" check at dispatch).
+GL108     Module-level import never referenced (dead import).
+========  ==============================================================
+
+Scope and honesty about limits: "traced code" means functions that are
+*visibly* traced in the same module — decorated with ``jax.jit`` (incl.
+``partial(jax.jit, ...)``) / ``vmap`` / ``grad`` / ``checkpoint`` etc.,
+or passed by name into a tracing entry point (``jax.jit(f)``,
+``lax.scan(body, ...)``, ``lax.cond``, ``lax.while_loop``, ...), plus
+defs nested inside those. There is no transitive call-graph analysis:
+a helper only ever called *from* traced code is not scanned. Likewise
+"traced value" is a forward dataflow approximation (parameters minus
+statics, plus locals assigned from expressions that touch traced names
+or ``jax.numpy``/``jax.lax``-namespace calls). False positives are
+expected and cheap: suppress a line with ``# graftlint: disable=GL1xx``
+or accept it into ``analysis/baseline.json`` with a justification
+(``baseline.py``); findings are identified by (rule, path, code-line
+text), not line numbers, so unrelated edits don't churn the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: rule id -> one-line summary (the full catalog lives in docs/ANALYSIS.md)
+RULES: Dict[str, str] = {
+    "GL101": "Python branch on a traced value inside traced code",
+    "GL102": "host/numpy call on a traced value inside traced code",
+    "GL103": "host RNG (random.* / np.random.*) inside traced code",
+    "GL104": "jnp/lax ops inside a Python for loop (unrolled-scan smell)",
+    "GL105": "device_get / block_until_ready in a hot-path module",
+    "GL106": "time.* / datetime.* nondeterminism inside traced code",
+    "GL107": "one allocation aliased across fields of one constructor",
+    "GL108": "dead import (module-level import never referenced)",
+}
+
+#: modules whose host syncs are throughput hazards (GL105). Matched with
+#: fnmatch against the repo-relative posix path.
+HOT_PATH_GLOBS: Tuple[str, ...] = (
+    "t2omca_tpu/run.py",
+    "t2omca_tpu/learners/*.py",
+    "t2omca_tpu/components/episode_buffer.py",
+    "t2omca_tpu/components/host_replay.py",
+    "t2omca_tpu/runners/*.py",
+)
+
+# tracing entry points: wrapping one of these around a function makes its
+# body traced code. Canonical (alias-resolved) dotted names.
+_TRACE_WRAPPERS = frozenset({
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.jacfwd", "jax.jacrev", "jax.hessian", "jax.checkpoint",
+    "jax.remat", "jax.custom_jvp", "jax.custom_vjp", "jax.linearize",
+})
+# control-flow primitives that trace callables handed to them
+_TRACE_CONSUMERS = frozenset({
+    "jax.lax.scan", "jax.lax.cond", "jax.lax.while_loop",
+    "jax.lax.fori_loop", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan", "jax.lax.custom_root",
+    "jax.lax.custom_linear_solve",
+})
+#: calls under these namespaces produce traced arrays (dataflow seed)
+_ARRAY_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.nn.", "jax.random.",
+                   "jax.scipy.", "jax.ops.")
+#: allocation calls whose result must not alias across donated leaves
+_ALLOC_NAMES = frozenset(
+    f"{ns}.{fn}" for ns in ("jax.numpy", "numpy")
+    for fn in ("zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+               "full_like", "empty_like", "arange", "eye"))
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?:=(?P<rules>\S+))?")
+_SKIP_FILE_RE = re.compile(r"#\s*graftlint:\s*skip-file")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One lint hit. ``key()`` (rule, path, code) is the baseline
+    identity — line numbers shift with every unrelated edit, the quoted
+    code line doesn't."""
+
+    path: str          # repo-relative posix path
+    line: int
+    col: int
+    rule: str
+    message: str
+    code: str          # stripped source line at ``line``
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.code)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} " \
+               f"{self.message}"
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` attribute chain -> "a.b.c" (None for anything else)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _ModuleLinter:
+    """One parsed module: alias resolution, traced-region discovery, and
+    the rule walks. Produces a deduplicated, line-sorted finding list."""
+
+    def __init__(self, src: str, path: str, hot: Optional[bool] = None):
+        self.src = src
+        self.path = path
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+        self.hot = (any(fnmatch.fnmatch(path, g) for g in HOT_PATH_GLOBS)
+                    if hot is None else hot)
+        #: local alias -> canonical module/function dotted path
+        self.modmap: Dict[str, str] = {}
+        #: function name -> [FunctionDef] (all scopes, by simple name)
+        self.defs: Dict[str, List[ast.FunctionDef]] = {}
+        #: id(FunctionDef) -> static parameter-name set
+        self.statics: Dict[int, Set[str]] = {}
+        self.findings: Set[Finding] = set()
+        self._collect_imports()
+        self._collect_defs()
+
+    # ------------------------------------------------------------ aliases
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.modmap[a.asname] = a.name
+                    else:
+                        root = a.name.split(".")[0]
+                        self.modmap[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                if node.module is None or node.level:
+                    continue        # relative imports: package-internal
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.modmap[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+
+    def canonical(self, node: ast.AST) -> Optional[str]:
+        """Alias-resolved dotted name of an expression (e.g. with
+        ``import jax.numpy as jnp``, ``jnp.zeros`` -> "jax.numpy.zeros");
+        None when the expression isn't a name chain."""
+        dotted = _dotted(node)
+        if dotted is None:
+            return None
+        root, _, rest = dotted.partition(".")
+        base = self.modmap.get(root)
+        if base is None:
+            return dotted
+        return f"{base}.{rest}" if rest else base
+
+    # ------------------------------------------------------ traced region
+
+    def _collect_defs(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, []).append(node)
+
+    def _static_params(self, fn: ast.FunctionDef,
+                       call: Optional[ast.Call]) -> Set[str]:
+        """static_argnames/static_argnums from a jit decorator or call
+        site (literal values only — dynamic specs are invisible to AST)."""
+        out: Set[str] = set()
+        keywords = list(call.keywords) if call is not None else []
+        args = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        for kw in keywords:
+            if kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value,
+                                                                  str):
+                        out.add(n.value)
+            elif kw.arg == "static_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value,
+                                                                  int):
+                        if 0 <= n.value < len(args):
+                            out.add(args[n.value])
+        return out
+
+    def traced_functions(self) -> List[Tuple[ast.FunctionDef, Set[str]]]:
+        """(FunctionDef, static-param-names) for every function this
+        module visibly hands to the tracer."""
+        marked: Dict[int, Tuple[ast.FunctionDef, Set[str]]] = {}
+
+        def mark(fn: ast.FunctionDef, statics: Set[str]) -> None:
+            cur = marked.get(id(fn))
+            marked[id(fn)] = (fn, (cur[1] | statics) if cur else statics)
+
+        # decorator route: @jax.jit / @partial(jax.jit, static_argnames=..)
+        for fns in self.defs.values():
+            for fn in fns:
+                for dec in fn.decorator_list:
+                    call = dec if isinstance(dec, ast.Call) else None
+                    target = call.func if call else dec
+                    name = self.canonical(target)
+                    if name == "functools.partial" and call and call.args:
+                        name = self.canonical(call.args[0])
+                    if name in _TRACE_WRAPPERS:
+                        mark(fn, self._static_params(fn, call))
+        # call-site route: jax.jit(f, ...), lax.scan(body, ...), ...
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self.canonical(node.func)
+            if name not in _TRACE_WRAPPERS | _TRACE_CONSUMERS:
+                continue
+            referenced: Set[str] = set()
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name):
+                        referenced.add(sub.id)
+            for ref in referenced:
+                for fn in self.defs.get(ref, []):
+                    mark(fn, self._static_params(fn, node)
+                         if name in _TRACE_WRAPPERS else set())
+        return list(marked.values())
+
+    # ---------------------------------------------------------- emission
+
+    def emit(self, node: ast.AST, rule: str, message: str) -> None:
+        line, col = node.lineno, node.col_offset + 1
+        code = (self.lines[line - 1].strip()
+                if 0 < line <= len(self.lines) else "")
+        m = _SUPPRESS_RE.search(self.lines[line - 1]) \
+            if 0 < line <= len(self.lines) else None
+        if m:
+            named = m.group("rules")
+            # bare `disable` suppresses everything on the line; a named
+            # list suppresses exactly those rules (case-normalized so a
+            # `disable=gl105` typo suppresses GL105, not the whole line)
+            if named is None or rule in {r.strip().upper()
+                                         for r in named.split(",")}:
+                return
+        self.findings.add(Finding(path=self.path, line=line, col=col,
+                                  rule=rule, message=message, code=code))
+
+    # ------------------------------------------------------ traced rules
+
+    def _is_traced_expr(self, expr: ast.AST, traced: Set[str]) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in traced:
+                return True
+            if isinstance(n, ast.Call):
+                c = self.canonical(n.func)
+                if c and c.startswith(_ARRAY_PREFIXES):
+                    return True
+        return False
+
+    def _traced_locals(self, fn: ast.FunctionDef, traced: Set[str]) -> Set[str]:
+        """Forward dataflow to fixpoint: locals assigned from traced
+        expressions become traced. Iterated until the set stops growing
+        — the lattice only grows and is bounded by the local-name count,
+        so this terminates; a fixed pass count would miss taint chains
+        written in reverse definition order (w = z; z = y; y = x)."""
+        traced = set(traced)
+        while True:
+            before = len(traced)
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and node is not fn:
+                    continue      # nested defs get their own analysis
+                targets: List[ast.expr] = []
+                value: Optional[ast.expr] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.For):
+                    targets, value = [node.target], node.iter
+                if value is None or not self._is_traced_expr(value, traced):
+                    continue
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            traced.add(n.id)
+            if len(traced) == before:
+                break
+        return traced
+
+    @staticmethod
+    def _static_test(test: ast.expr) -> bool:
+        """Branch tests that are static even on tracers: identity
+        against None, and isinstance/type checks."""
+        if isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return True
+        if isinstance(test, ast.Call) and isinstance(test.func, ast.Name) \
+                and test.func.id in ("isinstance", "callable", "hasattr"):
+            return True
+        return False
+
+    def _check_traced_function(self, fn: ast.FunctionDef,
+                               inherited: Set[str],
+                               statics: Set[str]) -> None:
+        params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)}
+        for extra in (fn.args.vararg, fn.args.kwarg):
+            if extra is not None:
+                params.add(extra.arg)
+        traced = (params - statics - {"self", "cls"}) | inherited
+        traced = self._traced_locals(fn, traced)
+
+        def walk(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    # nested def: traced region too, closure names carry
+                    self._check_traced_function(child, traced, set())
+                    continue
+                if isinstance(child, (ast.If, ast.While)) and \
+                        not self._static_test(child.test):
+                    if self._is_traced_expr(child.test, traced):
+                        kind = ("while" if isinstance(child, ast.While)
+                                else "if")
+                        self.emit(child, "GL101",
+                                  f"Python `{kind}` on a traced value in "
+                                  f"traced code — use jnp.where/lax.cond "
+                                  f"(or mark the argument static)")
+                if isinstance(child, ast.IfExp) and \
+                        not self._static_test(child.test) and \
+                        self._is_traced_expr(child.test, traced):
+                    self.emit(child, "GL101",
+                              "ternary on a traced value in traced code "
+                              "— use jnp.where")
+                if isinstance(child, ast.For):
+                    for sub in ast.walk(child):
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            break
+                        if isinstance(sub, ast.Call):
+                            c = self.canonical(sub.func)
+                            if c and c.startswith(("jax.numpy.",
+                                                   "jax.lax.", "jax.nn.")):
+                                self.emit(
+                                    child, "GL104",
+                                    f"`{c}` inside a Python for loop in "
+                                    f"traced code unrolls into the XLA "
+                                    f"graph — use lax.scan/fori_loop")
+                                break
+                if isinstance(child, ast.Call):
+                    self._check_traced_call(child, traced)
+                walk(child)
+
+        walk(fn)
+
+    def _check_traced_call(self, call: ast.Call, traced: Set[str]) -> None:
+        name = self.canonical(call.func)
+        argvals = list(call.args) + [kw.value for kw in call.keywords]
+        any_traced_arg = any(self._is_traced_expr(a, traced)
+                             for a in argvals)
+        if name in ("float", "int", "bool", "complex") and any_traced_arg:
+            self.emit(call, "GL102",
+                      f"`{name}()` on a traced value forces a host sync "
+                      f"(concretization) in traced code")
+        elif name in ("jax.device_get", "jax.block_until_ready"):
+            self.emit(call, "GL102",
+                      f"`{name}` inside traced code is a host round-trip "
+                      f"baked into the traced program")
+        elif name and name.startswith("numpy.random."):
+            self.emit(call, "GL103",
+                      f"`{name}` in traced code: host RNG draws become "
+                      f"trace-time constants — use jax.random")
+        elif name and (name == "random" or name.startswith("random.")):
+            self.emit(call, "GL103",
+                      f"`{name}` in traced code: host RNG draws become "
+                      f"trace-time constants — use jax.random")
+        elif name and name.startswith("numpy.") and any_traced_arg:
+            self.emit(call, "GL102",
+                      f"`{name}` on a traced value in traced code forces "
+                      f"a host transfer — use jax.numpy")
+        elif name and name.startswith(("time.", "datetime.")):
+            self.emit(call, "GL106",
+                      f"`{name}` in traced code is trace-time "
+                      f"nondeterminism baked in as a constant")
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr in ("item", "tolist") and not call.args and \
+                self._is_traced_expr(call.func.value, traced):
+            self.emit(call, "GL102",
+                      f"`.{call.func.attr}()` on a traced value forces a "
+                      f"host sync in traced code")
+
+    # ------------------------------------------------- module-scope rules
+
+    def _check_hot_path(self) -> None:
+        if not self.hot:
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self.canonical(node.func)
+            is_bur = (name == "jax.block_until_ready"
+                      or (isinstance(node.func, ast.Attribute)
+                          and node.func.attr == "block_until_ready"))
+            if name == "jax.device_get" or is_bur:
+                what = "jax.device_get" if name == "jax.device_get" \
+                    else "block_until_ready"
+                self.emit(node, "GL105",
+                          f"`{what}` in a hot-path module stalls the "
+                          f"dispatch pipeline — move to a cadence "
+                          f"boundary or baseline with justification")
+
+    def _check_donation_alias(self) -> None:
+        for fns in self.defs.values():
+            for fn in fns:
+                allocs: Set[str] = set()
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Assign) and \
+                            isinstance(node.value, ast.Call) and \
+                            self.canonical(node.value.func) in _ALLOC_NAMES:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                allocs.add(t.id)
+                if not allocs:
+                    continue
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = self.canonical(node.func)
+                    if name and (name.startswith(_ARRAY_PREFIXES)
+                                 or name.startswith("numpy.")):
+                        continue       # reads may alias; only state
+                    counts: Dict[str, int] = {}
+                    for a in list(node.args) + [kw.value
+                                                for kw in node.keywords]:
+                        if isinstance(a, ast.Name) and a.id in allocs:
+                            counts[a.id] = counts.get(a.id, 0) + 1
+                    for nm, c in counts.items():
+                        if c >= 2:
+                            self.emit(
+                                node, "GL107",
+                                f"allocation `{nm}` passed {c}x into one "
+                                f"constructor: donated leaves must be "
+                                f"distinct buffers (XLA donate-twice "
+                                f"check) — allocate per field")
+
+    def _check_dead_imports(self) -> None:
+        if self.path.endswith("__init__.py"):
+            return                     # re-export surface: imports ARE use
+        imported: Dict[str, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    imported[a.asname or a.name.split(".")[0]] = node
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for a in node.names:
+                    if a.name != "*":
+                        imported[a.asname or a.name] = node
+        used: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+        for node in ast.walk(self.tree):        # __all__ re-exports count
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "__all__"
+                    for t in node.targets):
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Constant) and \
+                            isinstance(sub.value, str):
+                        used.add(sub.value)
+        for name, node in sorted(imported.items()):
+            if name not in used:
+                self.emit(node, "GL108",
+                          f"`{name}` is imported but never used")
+
+    # ------------------------------------------------------------- drive
+
+    def run(self) -> List[Finding]:
+        if any(_SKIP_FILE_RE.search(l) for l in self.lines[:10]):
+            return []
+        for fn, statics in self.traced_functions():
+            self._check_traced_function(fn, set(), statics)
+        self._check_hot_path()
+        self._check_donation_alias()
+        self._check_dead_imports()
+        return sorted(self.findings,
+                      key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+# ---------------------------------------------------------------- frontend
+
+def lint_source(src: str, path: str = "<memory>",
+                hot: Optional[bool] = None) -> List[Finding]:
+    """Lint one source string (fixture entry point for the tests)."""
+    return _ModuleLinter(src, path, hot=hot).run()
+
+
+def lint_file(path: Path, root: Path) -> List[Finding]:
+    rel = path.resolve().relative_to(root.resolve()).as_posix()
+    return lint_source(path.read_text(), rel)
+
+
+def lint_package(root: Path,
+                 paths: Optional[Sequence[Path]] = None) -> List[Finding]:
+    """Lint every ``*.py`` under ``paths`` (default: ``root/t2omca_tpu``),
+    reporting paths relative to ``root`` (the repo root)."""
+    root = Path(root)
+    if paths is None:
+        paths = [root / "t2omca_tpu"]
+    findings: List[Finding] = []
+    for p in paths:
+        p = Path(p)
+        files: Iterable[Path] = (sorted(p.rglob("*.py")) if p.is_dir()
+                                 else [p])
+        for f in files:
+            findings.extend(lint_file(f, root))
+    return findings

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import ctypes
 import dataclasses
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import numpy as np

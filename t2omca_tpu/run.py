@@ -19,7 +19,7 @@ import dataclasses
 import os
 import time
 from collections import deque
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -32,7 +32,7 @@ still produced for parity with the M4 scheme.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Tuple
+from typing import Dict
 
 import jax
 import jax.numpy as jnp

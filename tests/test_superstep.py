@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from t2omca_tpu.analysis import (CompileBudgetExceeded, compile_budget,
+                                 no_transfer)
 from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,
                                ResilienceConfig, TrainConfig, sanity_check)
 from t2omca_tpu.run import Experiment, run, superstep_eligible
@@ -255,3 +257,124 @@ def test_nonfinite_guard_trips_inside_scan(tmp_path):
         with open(p) as f:
             rows.extend(json.loads(l)["key"] for l in f)
     assert "nonfinite_steps" in rows
+
+
+# --------------------------------------------------------------------------
+# tracing-hygiene enforcement at the program level (t2omca_tpu/analysis,
+# docs/ANALYSIS.md): the fused superstep's whole value is ONE compile and
+# ZERO host round-trips per K iterations — pinned here with the runtime
+# guards. Cheap toy-program guard tests (always in gate): tests/test_analysis.py.
+
+
+@pytest.mark.slow   # full superstep compile (~17 s) x2
+@pytest.mark.analysis
+def test_superstep_program_compile_budget():
+    """`Experiment.superstep_program` compiles exactly ONCE across K
+    dispatches — a silent retrace would erase the dispatch-amortization
+    win (the bug class run._strong exists to stop). And the budget must
+    FAIL when the program is made to retrace: passing a raw Python
+    scalar for t_env0 (instead of the committed int32 array the driver
+    passes) flips the aval to weak-typed and recompiles."""
+    cfg = tiny_cfg()
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(0)
+    superstep = exp.superstep_program(2)
+    keys = jax.random.split(jax.random.PRNGKey(1), 2)
+    with compile_budget(1, match="_superstep") as log:
+        for i in range(3):
+            ts, stats, infos = superstep(ts, keys,
+                                         jnp.asarray(i * 24, jnp.int32))
+    assert log.count == 1
+    assert np.isfinite(
+        np.asarray(jax.device_get(stats.episode_return))).all()
+
+    # retrace demonstration (ISSUE 3 acceptance): same computation, but
+    # one dispatch passes a Python scalar -> weak_type aval -> recompile
+    prog2 = exp.superstep_program(2)
+    ts2 = exp.init_train_state(0)
+    with pytest.raises(CompileBudgetExceeded, match="_superstep"):
+        with compile_budget(1, match="_superstep"):
+            ts2, _, _ = prog2(ts2, keys, jnp.asarray(0, jnp.int32))
+            prog2(ts2, keys, 24)
+
+
+@pytest.mark.slow   # mesh-sharded superstep compile on the 8-device CPU mesh
+@pytest.mark.analysis
+def test_dataparallel_superstep_compile_budget():
+    """`DataParallel.superstep_program` too: the constraint hooks pin
+    output shardings to the canonical input placement, so dispatch 2+
+    reuses the executable — GSPMD choosing a different output sharding
+    would silently compile a second program every iteration."""
+    from t2omca_tpu.parallel import DataParallel, make_mesh
+    cfg = tiny_cfg()
+    exp = Experiment.build(cfg)
+    dp = DataParallel(exp, make_mesh(2))
+    ts = dp.init_sharded(cfg.seed)           # born sharded, outside budget
+    superstep = dp.superstep_program(2)
+    keys = jax.random.split(jax.random.PRNGKey(1), 2)
+    with compile_budget(1, match="_superstep") as log:
+        for i in range(3):
+            ts, stats, infos = superstep(ts, keys,
+                                         jnp.asarray(i * 24, jnp.int32))
+    assert log.count == 1
+    assert int(jax.device_get(ts.episode)) == 12
+
+
+@pytest.mark.slow   # rollout+insert+train compiles (~15 s)
+@pytest.mark.analysis
+def test_train_iter_compile_budget():
+    """The classic-loop learner step (`_train_iter`) holds one compile
+    across iterations at fixed shapes — the driver feeds back
+    weak-type-stripped state (run._strong) precisely so iteration 2
+    doesn't silently recompile."""
+    cfg = tiny_cfg()
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(cfg.seed)
+    rollout, insert, train_iter = exp.jitted_programs()
+    key = jax.random.PRNGKey(cfg.seed + 1)
+    spr = cfg.batch_size_run * cfg.env_args.episode_limit
+    t_env = 0
+    for _ in range(2):                       # fill to batch_size episodes
+        rs, batch, _ = rollout(ts.learner.params["agent"], ts.runner,
+                               test_mode=False)
+        ts = ts.replace(runner=rs, buffer=insert(ts.buffer, batch),
+                        episode=ts.episode + cfg.batch_size_run)
+        t_env += spr
+    with compile_budget(1, match="_train_iter") as log:
+        for _ in range(3):
+            key, k = jax.random.split(key)
+            t_env += spr
+            ts, info = train_iter(ts, k, jnp.asarray(t_env))
+    assert log.count == 1
+    assert int(jax.device_get(ts.learner.train_steps)) == 3
+
+
+@pytest.mark.slow   # superstep compile (~17 s)
+@pytest.mark.analysis
+def test_superstep_no_implicit_transfer_between_dispatches():
+    """One fused dispatch on the K>1 path runs with ZERO implicit host
+    transfers: every per-dispatch input is a committed device array
+    (keys stack, int32 t_env), every output stays on device. A Python
+    scalar sneaking into the dispatch args — simultaneously a retrace
+    hazard, see above — is exactly what the guard rejects. (On this CPU
+    backend only the host→device direction has teeth; on a real
+    accelerator no_transfer() also rejects implicit device→host
+    fetches between boundaries.)"""
+    cfg = tiny_cfg()
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(0)
+    superstep = exp.superstep_program(2)
+    keys = jax.random.split(jax.random.PRNGKey(1), 2)
+    ts, stats, infos = superstep(ts, keys, jnp.asarray(0, jnp.int32))
+    # compile + constant upload happened above; dispatch 2 must be clean
+    t1 = jnp.asarray(24, jnp.int32)
+    with no_transfer():
+        ts, stats, infos = superstep(ts, keys, t1)
+        jax.block_until_ready(stats.epsilon)   # barrier, not a transfer
+    # seeded hazard: a per-dispatch Python scalar is an implicit upload
+    with pytest.raises(Exception, match="[Tt]ransfer"):
+        with no_transfer():
+            superstep(ts, keys, 48)
+    # explicit cadence-boundary fetches stay allowed under the guard
+    with no_transfer():
+        assert int(jax.device_get(ts.episode)) == 8
