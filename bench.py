@@ -28,16 +28,26 @@ import time
 import numpy as np
 
 
-def probe_backend(probe_s: float) -> "dict | None":
+def probe_backend(probe_s: float, _cmd=None) -> "dict | None":
     """Bounded backend-init probe in a SUBPROCESS, one retry. A wedged
     axon tunnel blocks ``jax.devices()`` ~25 min inside backend init
     (BASELINE.md) — longer than most callers' own timeouts — and a
     blocked in-process thread can never be joined, so the probe runs
     ``jax.devices()`` in a child process the parent can kill at the
     bound. Returns ``None`` on success, else a structured
-    ``{"error", "phase"}`` dict for the failure record. A healthy init
-    is seconds; the bound only fires on a dead tunnel, where no claim is
-    held yet, so killing the child cannot wedge the remote further.
+    ``{"error", "phase"}`` dict for the failure record — ``phase`` is
+    ``"timeout"`` when the bound fired (the wedged-tunnel shape) and
+    ``"backend_init"`` when the child itself failed (backend error with
+    a real stderr). A healthy init is seconds; the bound only fires on a
+    dead tunnel, where no claim is held yet, so killing the child cannot
+    wedge the remote further.
+
+    The child is spawned via ``Popen`` so the timeout path OWNS the
+    cleanup: kill + ``wait`` in a ``finally``, guaranteeing the child is
+    dead AND reaped (no zombie accumulating against the caller's pid
+    limit — a soak loop hitting a wedged tunnel would otherwise leak one
+    defunct process per probe). ``_cmd`` overrides the probed command for
+    tests (a sleeping child stands in for the wedged init).
 
     Deliberate cost: the child's backend init is thrown away, so a
     healthy run initializes twice (seconds on CPU/local TPU). That buys
@@ -45,30 +55,40 @@ def probe_backend(probe_s: float) -> "dict | None":
     joined once wedged and had to ``os._exit`` the whole bench — plus
     the retry, which distinguishes a transient tunnel blip from a wedge
     before any measurement time is spent."""
+    cmd = _cmd or [sys.executable, "-c", "import jax; jax.devices()"]
     # the bound is TOTAL across both attempts (probe_s/2 each): callers
     # tune their own timeouts against probe_s, and a retry that doubled
     # the worst case would push the error record past them — recreating
     # the no-record-on-stdout failure this probe exists to prevent
     per_attempt = probe_s / 2.0
-    last = "probe never ran"
+    last, phase = "probe never ran", "timeout"
     for attempt in (1, 2):
         if per_attempt <= 0:
             last = (f"backend init exceeded {per_attempt:.0f}s probe "
                     f"bound (attempt {attempt}/2; wedged tunnel?)")
+            phase = "timeout"
             continue
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
         try:
-            proc = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                capture_output=True, text=True, timeout=per_attempt)
+            _, err = proc.communicate(timeout=per_attempt)
         except subprocess.TimeoutExpired:
             last = (f"backend init exceeded {per_attempt:.0f}s probe "
                     f"bound (attempt {attempt}/2; wedged tunnel?)")
+            phase = "timeout"
             continue
+        finally:
+            # kill AND reap unconditionally: communicate() does not kill
+            # on timeout, and a killed-but-unreaped child is a zombie
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait()
         if proc.returncode == 0:
             return None
         last = (f"backend unavailable (attempt {attempt}/2): "
-                f"{proc.stderr.strip()[-400:]}")
-    return {"error": last[:500], "phase": "backend_init"}
+                f"{err.strip()[-400:]}")
+        phase = "backend_init"
+    return {"error": last[:500], "phase": phase}
 
 
 def _sync(x):

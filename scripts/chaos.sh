@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Chaos soak runner (docs/RESILIENCE.md §5): cycle the fault-injection
+# battery — hang at dispatch.superstep, transient + persistent dispatch
+# failures, flaky checkpoint gather, crash mid-checkpoint, SIGTERM — for
+# N iterations against the real driver on the CPU backend, asserting
+# after every scenario that the run ended in a RESUMABLE state (a
+# verify_checkpoint-passing checkpoint a fresh driver carries to t_max).
+#
+# Usage: bash scripts/chaos.sh [N]      (default N=3)
+#
+# Slow by design (each scenario is a full run() with fresh compiles, the
+# battery is ~6 runs + resume legs per cycle) — this is the soak gate for
+# resilience PRs, not part of the tier-1 budget (the same scenarios run
+# once under `-m 'chaos'`; tier-1 excludes them via `-m 'not slow'`).
+set -o pipefail
+N=${1:-3}
+cd "$(dirname "$0")/.." || exit 2
+for i in $(seq 1 "$N"); do
+  echo "== chaos cycle $i/$N =="
+  JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -m chaos -q \
+    -p no:cacheprovider -p no:randomly || {
+      echo "chaos cycle $i/$N FAILED — a fault scenario left the run "
+      echo "unresumable (see the assertion above; docs/RESILIENCE.md §5)"
+      exit 1
+    }
+done
+echo "chaos soak passed: $N cycle(s), every scenario ended resumable"

@@ -197,6 +197,14 @@ class HostReplayBuffer:
         self._pending_update = (np.asarray(idx, np.int64), td_ref,
                                 finite_ref)
 
+    def drop_pending_update(self) -> None:
+        """Abandon deferred priority feedback WITHOUT consuming it. The
+        driver's checkpoint restore calls this when the train step that
+        produced the refs was rolled back — fetching them would stamp the
+        abandoned computation's |TD| into the sum-tree, or re-raise a
+        fault from a poisoned device array outside any ladder routing."""
+        self._pending_update = None
+
     def flush_priority_updates(self) -> None:
         """Consume the deferred priority feedback, if any. A tripped
         (non-finite) train step leaves the sum-tree untouched — NaN

@@ -163,6 +163,40 @@ class ResilienceConfig:
     # by NaN at exactly this learner train step (-1 = off). Static config,
     # so the disabled case costs nothing inside jit.
     inject_nan_at_step: int = -1
+    # ---- hang detection & degradation ladder (docs/RESILIENCE.md §5) ----
+    # watchdog stall threshold in seconds for any device-facing call
+    # (dispatch, collective, checkpoint gather). 0 = watchdog fully
+    # disabled — the driver behaves bit-identically to a build without it.
+    # Size it to a few× the slowest expected dispatch (superstep K ×
+    # iteration time, or the checkpoint gather at cadence).
+    dispatch_timeout: float = 0.0
+    # the FIRST occurrence of each watched phase includes the XLA compile
+    # (tens of seconds on CPU, minutes at production shapes) and is
+    # therefore exempt from dispatch_timeout; this key bounds it instead.
+    # 0 = unbounded first occurrence (the default — compile times are
+    # config-dependent); set it explicitly to catch startup hangs (the
+    # wedged-tunnel-at-backend-init shape, BASELINE.md's ~25 min block).
+    # Only meaningful alongside dispatch_timeout > 0 (the watchdog is
+    # not constructed otherwise — sanity_check rejects the dead combo).
+    first_dispatch_timeout: float = 0.0
+    # after the watchdog fired (diagnosis persisted + emergency checkpoint
+    # attempted), how long to wait for the stalled call to return before a
+    # hard process exit with stall_exit_code. 0 = never hard-exit (rely on
+    # the orderly ShutdownGuard path once the call returns).
+    stall_grace_s: float = 300.0
+    # process exit code of the hard watchdog exit — distinct from 0
+    # (orderly) and 1 (crash) so supervisors can count stall restarts
+    stall_exit_code: int = 17
+    # degradation ladder (utils/watchdog.py): in-place retries of a failed
+    # dispatch before escalating a rung (transient-classified errors only;
+    # deterministic errors propagate immediately). Exponential backoff
+    # from retry_backoff_s with jitter between attempts.
+    dispatch_retries: int = 2
+    retry_backoff_s: float = 0.5
+    # ladder rung 1: on exhausted retries of the fused superstep, fall
+    # back to K=1 (smaller blast radius — each dispatch then risks one
+    # iteration, not K) before restoring a checkpoint
+    degrade_superstep: bool = True
 
 
 @dataclass(frozen=True)
@@ -372,6 +406,33 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
         raise ValueError(
             f"resilience.keep_last/keep_every must be >= 0, got "
             f"keep_last={res.keep_last}, keep_every={res.keep_every}")
+    if res.dispatch_timeout < 0:
+        raise ValueError(f"resilience.dispatch_timeout must be >= 0 "
+                         f"(0 disables the watchdog), got "
+                         f"{res.dispatch_timeout}")
+    if res.first_dispatch_timeout < 0:
+        raise ValueError(f"resilience.first_dispatch_timeout must be >= 0 "
+                         f"(0 leaves first occurrences unbounded), got "
+                         f"{res.first_dispatch_timeout}")
+    if res.first_dispatch_timeout > 0 and res.dispatch_timeout == 0:
+        raise ValueError(
+            "resilience.first_dispatch_timeout only bounds the compile-"
+            "exempt FIRST occurrence of each watched phase — with "
+            "dispatch_timeout=0 the watchdog is never constructed and "
+            "the key is silently dead; set dispatch_timeout > 0 too")
+    if res.stall_grace_s < 0:
+        raise ValueError(f"resilience.stall_grace_s must be >= 0 "
+                         f"(0 disables the hard exit), got "
+                         f"{res.stall_grace_s}")
+    if not 1 <= res.stall_exit_code <= 255:
+        raise ValueError(f"resilience.stall_exit_code must be in 1..255 "
+                         f"(0 means orderly exit to supervisors), got "
+                         f"{res.stall_exit_code}")
+    if res.dispatch_retries < 0 or res.retry_backoff_s < 0:
+        raise ValueError(
+            f"resilience.dispatch_retries/retry_backoff_s must be >= 0, "
+            f"got dispatch_retries={res.dispatch_retries}, "
+            f"retry_backoff_s={res.retry_backoff_s}")
     if res.inject_nan_at_step >= 0 and res.nonfinite_tolerance == 0:
         raise ValueError(
             "resilience.inject_nan_at_step is a fault-injection knob whose "

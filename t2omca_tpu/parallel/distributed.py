@@ -20,21 +20,40 @@ unconditional auto-detect would be wrong for the common single-host case.
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Optional
 
 import jax
 
+from ..utils import resilience
+from ..utils.watchdog import retry_call
+
 
 def maybe_initialize_distributed(
         coordinator_address: Optional[str] = None,
         num_processes: Optional[int] = None,
-        process_id: Optional[int] = None) -> bool:
+        process_id: Optional[int] = None,
+        retries: Optional[int] = None) -> bool:
     """Initialize the multi-host runtime when a topology is configured.
 
     Returns True when ``jax.distributed.initialize`` ran (or had already
     run), False when no multi-host topology is configured — single-host
     runs are unaffected. Idempotent: a second call is a no-op.
+
+    The init is a rendezvous: every process races to the coordinator, and
+    a transient loss (coordinator pod not yet scheduled, gloo transport
+    handshake crashing — the ``EnforceNotMet`` flake CHANGES.md records at
+    ~50% on oversubscribed CPU) used to kill the whole job at step zero.
+    Transient-classified failures now retry with exponential backoff
+    (``utils.watchdog.retry_call``). ``retries`` — from the argument or
+    ``T2OMCA_INIT_RETRIES``, default 2 — counts retries BEYOND the first
+    attempt (total attempts = 1 + retries), matching the
+    ``resilience.dispatch_retries`` convention everywhere else; a
+    non-numeric env value is ignored with a warning. Deterministic
+    errors (bad topology arguments) still fail on the first attempt. The
+    ``backend.init`` fault-injection point fires inside each attempt
+    (docs/RESILIENCE.md §4).
     """
     addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     nproc = num_processes if num_processes is not None else int(
@@ -52,12 +71,66 @@ def maybe_initialize_distributed(
         kwargs["num_processes"] = nproc
     if pid >= 0:
         kwargs["process_id"] = pid
-    try:
-        jax.distributed.initialize(**kwargs)
-    except RuntimeError as e:
-        # idempotency via the runtime's own double-init error (there is no
-        # public already-initialized predicate to query)
-        if "already" in str(e).lower():
-            return True
-        raise
-    return True
+    if retries is None:
+        raw = os.environ.get("T2OMCA_INIT_RETRIES", "")
+        try:
+            retries = int(raw) if raw else 2
+        except ValueError:
+            logging.getLogger("t2omca").warning(
+                f"ignoring non-numeric T2OMCA_INIT_RETRIES={raw!r} "
+                f"(using the default of 2 retries)")
+            retries = 2
+    # retries counts attempts BEYOND the first (resilience.dispatch_retries
+    # convention): retries=2 -> 3 total attempts
+    attempts = 1 + max(retries, 0)
+    attempt_box = [0]
+
+    def _reset_partial_init() -> None:
+        # jax 0.4.37 assigns global_state.service/.client BEFORE
+        # client.connect() (jax/_src/distributed.py), so a failed
+        # rendezvous leaves the runtime half-initialized and a bare
+        # retry dies on the double-init RuntimeError instead of
+        # re-attempting. Best-effort teardown so the next attempt
+        # starts from a clean state; never let cleanup mask the
+        # original (classifiable) error.
+        try:
+            jax.distributed.shutdown()
+        except Exception:       # noqa: BLE001 — half-connected client
+            try:
+                from jax._src import distributed as _dist
+                st = _dist.global_state
+                st.client = None
+                if st.service is not None:
+                    try:
+                        st.service.shutdown()
+                    except Exception:   # noqa: BLE001
+                        pass
+                    st.service = None
+            except Exception:   # noqa: BLE001 — jax internals moved
+                pass
+
+    def _init_once() -> bool:
+        attempt_box[0] += 1
+        resilience.fire("backend.init", attempt=attempt_box[0])
+        try:
+            jax.distributed.initialize(**kwargs)
+        except Exception as e:
+            # idempotency via the runtime's own double-init error (there
+            # is no public already-initialized predicate to query; jax
+            # 0.4.37 phrases it "should only be called once") — but only
+            # on the FIRST attempt, where it can only mean a previous
+            # successful call. On a retry the same message means THIS
+            # call's failed attempt left the runtime half-initialized
+            # and _reset_partial_init could not clean it up; reporting
+            # success would hand back a never-connected runtime that
+            # wedges at the first collective.
+            msg = str(e).lower()
+            if ("already" in msg or "only be called once" in msg) \
+                    and attempt_box[0] == 1:
+                return True
+            _reset_partial_init()
+            raise
+        return True
+
+    return retry_call(_init_once, attempts=attempts,
+                      label="jax.distributed.initialize")

@@ -17,8 +17,10 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 import time
 from collections import deque
+from contextlib import nullcontext
 from typing import Optional
 
 import jax
@@ -35,7 +37,7 @@ from .learners.qmix_learner import LEARNER_REGISTRY, LearnerState
 from .runners import RUNNER_REGISTRY
 from .runners.episode_runner import EpisodeRunner
 from .runners.parallel_runner import ParallelRunner, RunnerState
-from .utils import resilience
+from .utils import resilience, watchdog
 from .utils.checkpoint import (find_checkpoint, load_checkpoint,
                                prune_checkpoints, save_checkpoint)
 from .utils.logging import Logger
@@ -466,6 +468,98 @@ def run_sequential(exp: Experiment, logger: Logger,
     nonfinite_total = 0
     restores = 0                    # guard-triggered checkpoint restores
 
+    # ---- hang detection + degradation ladder (RESILIENCE.md §5) --------
+    # The watchdog's stall callback runs in the WATCHDOG thread — the main
+    # thread is blocked inside the stalled call — so the emergency
+    # checkpoint comes from the pre-dispatch state stamped with the
+    # heartbeat: complete and consistent, because the dispatch that would
+    # have superseded it never finished. A stall during the checkpoint
+    # write itself skips the save (the staging directory is in use by the
+    # stalled writer); donated-and-consumed state is skipped too (its
+    # buffers are gone — resume falls back to the last cadence save).
+    # serializes the watchdog thread's emergency save against the main
+    # thread's cadence/exit saves: both stage into the same tmp.<t_env>
+    # directory, and a bounded wd.stop() join can hand control back to
+    # the main thread while the watchdog's save is still mid-write
+    save_lock = threading.Lock()
+
+    def _acquire_save_lock(where: str) -> bool:
+        """BOUNDED acquire shared by every save site: an emergency save
+        wedged inside the stalled backend can hold the lock forever, and
+        each waiter (watchdog callback, save cadence, exit path) must
+        skip with a warning instead of inheriting the hang — resume then
+        falls back to the newest published checkpoint."""
+        if save_lock.acquire(timeout=max(res.stall_grace_s, 60.0)):
+            return True
+        log.warning(f"{where}: checkpoint skipped — an emergency save "
+                    f"still holds the save lock (wedged backend?); "
+                    f"resume falls back to the newest published "
+                    f"checkpoint")
+        return False
+
+    def _on_stall(diag: watchdog.StallDiagnosis) -> None:
+        watchdog.write_diagnosis(diag, model_dir)
+        # trip the guard BEFORE the save attempt: the emergency save
+        # below reads device state over the possibly-wedged backend and
+        # can block without raising — with stall_grace_s=0 (no hard
+        # exit) a guard tripped only afterwards would never trip at all,
+        # and the orderly "rely on the ShutdownGuard path once the call
+        # returns" fallback the config documents could never run
+        guard.request("watchdog")
+        # single-process only, same reason as the cadence-save retry
+        # below: save_checkpoint is a lockstep collective sequence in
+        # multi-host, and a one-sided save from THIS process's stalled
+        # watchdog would hang in sync_global_devices barriers its
+        # (healthy, not-saving) peers never enter — wedging the watchdog
+        # thread while it holds save_lock. Multi-host stalls still get
+        # the diagnosis + guard trip; resume falls back to the last
+        # cadence save.
+        if (cfg.save_model and res.emergency_checkpoint
+                and jax.process_count() == 1
+                and not diag.phase.startswith("checkpoint")
+                and diag.state is not None
+                and watchdog.state_intact(diag.state)):
+            # stall callbacks run on their own threads (the monitor
+            # keeps watching), so a previous callback wedged inside the
+            # stalled backend may still hold the lock — blocking
+            # unbounded here would just stack dead threads
+            if not _acquire_save_lock("watchdog emergency save"):
+                return
+            try:
+                save_to = save_checkpoint(
+                    model_dir, diag.t_env, diag.state,
+                    gather_retries=res.dispatch_retries,
+                    gather_backoff_s=res.retry_backoff_s)
+                log.warning(f"watchdog: emergency checkpoint saved to "
+                            f"{save_to}")
+            except Exception as e:  # noqa: BLE001 — device may be wedged
+                log.warning(f"watchdog: emergency checkpoint failed "
+                            f"({e!r}); resume falls back to the last "
+                            f"cadence save")
+            finally:
+                save_lock.release()
+
+    wd = None
+    if res.dispatch_timeout > 0:
+        wd = watchdog.Watchdog(
+            res.dispatch_timeout, on_stall=_on_stall,
+            grace_s=res.stall_grace_s, exit_code=res.stall_exit_code,
+            first_timeout_s=res.first_dispatch_timeout).start()
+        log.info(f"dispatch watchdog armed: timeout="
+                 f"{res.dispatch_timeout}s (first occurrence of each "
+                 f"phase: {res.first_dispatch_timeout or 'unbounded'}, "
+                 f"compile exemption), hard-exit grace="
+                 f"{res.stall_grace_s}s (exit {res.stall_exit_code})")
+    ladder = watchdog.DegradationLadder(res.max_restores)
+    dispatch_faults = 0             # transient dispatch errors seen (stats)
+
+    def _watched(phase, state=None):
+        """One watchdog stamp for a device-facing region (no-op context
+        when the watchdog is disabled) — keeps the wd-None guard and the
+        current-t_env threading in one place instead of at every site."""
+        return (wd.watch(phase, t_env=t_env, state=state)
+                if wd is not None else nullcontext())
+
     last_test_t = t_env - cfg.test_interval - 1
     last_log_t = t_env
     last_save_t = t_env if t_env else -cfg.save_model_interval - 1
@@ -511,6 +605,160 @@ def run_sequential(exp: Experiment, logger: Logger,
     buffer_capacity = 0 if exp.host_buffer else exp.buffer.capacity
     inflight = deque()              # rollout outputs not yet waited on
 
+    # ---- fault-handled dispatch + ladder plumbing (RESILIENCE.md §5) ---
+    def _dispatch(phase, fn, state, retryable=True, **context):
+        """One device-facing dispatch: fault-injection hook + watchdog
+        heartbeat + bounded in-place retry with backoff (ladder rung 0).
+        Transient-classified failures retry ``fn`` with the SAME inputs —
+        the callers commit their host mirrors only after success, so a
+        retry replays an identical dispatch. Pass ``retryable=False``
+        when ``fn`` carries non-idempotent HOST side effects the
+        commit-after-success discipline cannot cover (the host-buffer
+        path: ``buffer.sample()`` advances the host RNG and the ring
+        insert mutates host RAM before a transient h2d/sync failure
+        surfaces, and ``state_intact`` can't see host mutations — a
+        retry would train on a different batch or double-insert); the
+        first transient failure then goes straight to the ladder.
+        Deterministic errors propagate immediately (retrying a shape bug
+        only delays the real diagnosis); exhausted retries — or a
+        failure that already consumed the donated state — raise
+        DispatchFailed for the ladder. Deliberately NOT composed from
+        watchdog.retry_call: the per-attempt stamp+fire, the donation
+        check, and the exhaustion→DispatchFailed conversion don't fit
+        its propagate-last-error contract."""
+        nonlocal dispatch_faults
+        attempts = (1 + res.dispatch_retries) if retryable else 1
+        for attempt in range(1, attempts + 1):
+            try:
+                with _watched(phase, state):
+                    # the hook fires INSIDE the watched region: an
+                    # injected sleep here is indistinguishable from a
+                    # hung dispatch to the watchdog (tests rely on this)
+                    resilience.fire(phase, t_env=t_env, attempt=attempt,
+                                    **context)
+                    return fn()
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not watchdog.is_transient(e):
+                    raise
+                dispatch_faults += 1
+                if attempt >= attempts or not watchdog.state_intact(state):
+                    raise watchdog.DispatchFailed(phase, attempt, e) from e
+                delay = watchdog.backoff_delay(attempt, res.retry_backoff_s)
+                log.warning(f"{phase}: transient dispatch failure "
+                            f"(attempt {attempt}/{attempts}), retrying "
+                            f"in {delay:.2f}s: {type(e).__name__}: {e}")
+                time.sleep(delay)
+
+    def _restore_checkpoint(dirname, step):
+        """Reload a published checkpoint and re-sync every host-side
+        mirror of device state — shared by the non-finite escalation and
+        the degradation ladder's restore rung."""
+        nonlocal ts, t_env, episode, buffer_filled, train_infos
+        nonlocal last_test_t, last_log_t, last_runner_log_t, last_save_t
+        nonlocal nonfinite_streak, train_acc
+        ts = load_checkpoint(dirname, ts, verify=False)
+        ts = ts.replace(runner=ts.runner.replace(
+            t_env=jnp.asarray(step, jnp.int32)))
+        if dp is not None:
+            ts = dp.shard(ts)
+        # re-sync every host-side mirror of device state
+        t_env = step
+        episode = int(jax.device_get(ts.episode))
+        if not exp.host_buffer:
+            buffer_filled = int(jax.device_get(
+                ts.buffer.episodes_in_buffer))
+        inflight.clear()
+        train_infos = []
+        # the restored state predates whatever streak was counted — a
+        # stale streak would double-count the replayed steps (the ladder
+        # restore shares this path, not just the non-finite escalation)
+        nonfinite_streak = 0
+        # drop pending stats too: their device refs belong to the
+        # rolled-back (possibly poisoned) computation, and the replayed
+        # iterations will re-push them — flushing the stale ones would
+        # both double-count episodes and re-raise the fault at the next
+        # cadence fetch, outside any routing
+        train_acc = StatsAccumulator()
+        if exp.host_buffer:
+            # same hazard for the host-replay deferred priority refs:
+            # they came from the rolled-back train step
+            exp.buffer.drop_pending_update()
+        last_test_t = last_log_t = t_env
+        last_runner_log_t = last_save_t = t_env
+
+    def _dispatch_ladder(df: watchdog.DispatchFailed,
+                         can_degrade: Optional[bool] = None) -> None:
+        """Rungs above in-place retry: superstep K→1 (smaller blast
+        radius), restore the last good checkpoint, abort with the
+        captured diagnosis. Mutates the loop shape; callers ``continue``
+        after it returns (their host mirrors were never committed, so the
+        abandoned dispatch leaves no trace). Pass ``can_degrade=False``
+        from boundaries where degrading cannot help — a failure surfacing
+        at a sync/fetch point means the already-dispatched computation
+        (or its output state) is suspect, so only restore can stand."""
+        nonlocal K, superstep
+        # a dispatch whose donated inputs were consumed mid-failure left
+        # ts unusable — degrading and continuing would dereference
+        # deleted arrays; only the restore rung can stand on it (the
+        # deleted leaves still carry shape metadata, which is all the
+        # load_checkpoint template needs)
+        if can_degrade is None:
+            can_degrade = (K > 1 and res.degrade_superstep
+                           and watchdog.state_intact(ts))
+        action = ladder.next_action(can_degrade=can_degrade)
+        logger.log_stat("dispatch_failures", ladder.failures, t_env)
+        if action == "degrade":
+            log.warning(f"degradation ladder: {df} — falling back "
+                        f"superstep K={K} -> 1 ({ladder.describe()})")
+            K = 1
+            superstep = None
+            logger.log_stat("superstep_k", 1, t_env)
+            return
+        if action == "restore":
+            good = find_checkpoint(model_dir) if cfg.save_model else None
+            if good is not None:
+                log.warning(f"degradation ladder: {df} — restoring last "
+                            f"good checkpoint {good[0]} "
+                            f"({ladder.describe()})")
+                _restore_checkpoint(*good)
+                return
+            # no checkpoint to stand on: fall through to abort
+        # consume the stall diagnosis only on abort: a degrade/restore
+        # rung leaves it for the guard-triggered exit log (the causal
+        # "stalled call eventually returned" chain) or a later abort
+        diag = wd.take_diagnosis() if wd is not None else None
+        raise RuntimeError(
+            f"dispatch failure exhausted the degradation ladder at "
+            f"t_env={t_env} ({ladder.describe()})"
+            + (f"; stall diagnosis: {diag.message()}" if diag else "")
+            + ("" if cfg.save_model else
+               "; no checkpoints exist to restore (save_model off)")
+            + f" — last failure: {df}") from df
+
+    def _sync_point(phase, fn):
+        """One blocking sync/fetch boundary (run-ahead wait, cadence stat
+        fetch): watchdog stamp + fault-injection hook + transient
+        classification in one place. On the production path
+        (``sync_stages`` off) these host round-trips are where a
+        device-side wedge or async fault actually surfaces, so each must
+        carry a stamp — an unstamped blocking fetch is exactly the
+        silent hang this layer exists to bound. No in-place retry is
+        possible here (the already-dispatched computation's donated
+        inputs are gone and its outputs are suspect), so a transient
+        failure raises ``DispatchFailed`` for the caller to route to the
+        ladder with ``can_degrade=False`` — restore is the only rung
+        that can stand; deterministic errors propagate unwrapped."""
+        nonlocal dispatch_faults
+        try:
+            with _watched(phase, ts):
+                resilience.fire(phase, t_env=t_env)
+                return fn()
+        except Exception as e:  # noqa: BLE001 — classified below
+            if not watchdog.is_transient(e):
+                raise
+            dispatch_faults += 1
+            raise watchdog.DispatchFailed(phase, 1, e) from e
+
     # signal handlers are process-global state: restore them on
     # EVERY exit (normal, preemption, divergence abort)
     try:
@@ -535,25 +783,43 @@ def run_sequential(exp: Experiment, logger: Logger,
                 # which sub-iterations train — it splits the driver key
                 # stream ONLY for those (bit-identical threading to the
                 # K=1 loop's conditional split) and keeps their stacked
-                # info rows, dropping the zero rows of skipped ones
+                # info rows, dropping the zero rows of skipped ones.
+                # Computed from snapshots and COMMITTED only after the
+                # dispatch succeeds: an in-place retry (or a ladder rung
+                # abandoning this dispatch) replays the identical key
+                # stream, preserving bit-parity with the K=1 loop.
+                key2, ep2, fill2 = key, episode, buffer_filled
                 key_rows, gated = [], []
                 for _ in range(K):
-                    episode += cfg.batch_size_run
-                    buffer_filled = min(buffer_filled + cfg.batch_size_run,
-                                        buffer_capacity)
-                    g = (buffer_filled >= cfg.batch_size
-                         and episode >= cfg.accumulated_episodes)
+                    ep2 += cfg.batch_size_run
+                    fill2 = min(fill2 + cfg.batch_size_run,
+                                buffer_capacity)
+                    g = (fill2 >= cfg.batch_size
+                         and ep2 >= cfg.accumulated_episodes)
                     gated.append(g)
                     if g:
-                        key, k_sample = jax.random.split(key)
+                        key2, k_sample = jax.random.split(key2)
                         key_rows.append(k_sample)
                     else:
-                        key_rows.append(jnp.zeros_like(key))
-                with timer.stage("superstep"):
+                        key_rows.append(jnp.zeros_like(key2))
+                def _fused(ts=ts, key_rows=key_rows):
                     ts, stats, infos = superstep(ts, jnp.stack(key_rows),
                                                  jnp.asarray(t_env))
                     if sync_stages:
+                        # inside the dispatched fn so the barrier (where
+                        # a device-side wedge actually surfaces) is
+                        # covered by the watchdog stamp + retry, like
+                        # _roll/_train_once below
                         jax.block_until_ready(stats.epsilon)
+                    return ts, stats, infos
+                try:
+                    with timer.stage("superstep"):
+                        ts, stats, infos = _dispatch("dispatch.superstep",
+                                                     _fused, ts, k=K)
+                except watchdog.DispatchFailed as df:
+                    _dispatch_ladder(df)
+                    continue
+                key, episode, buffer_filled = key2, ep2, fill2
                 t_env += K * steps_per_rollout
                 for i, g in enumerate(gated):
                     if g:
@@ -561,7 +827,7 @@ def run_sequential(exp: Experiment, logger: Logger,
                             jax.tree.map(lambda x, i=i: x[i], infos))
             else:
                 # ------------ rollout (no grad by construction) -------------
-                with timer.stage("rollout"):
+                def _roll(ts=ts):
                     rs, batch, stats = rollout(ts.learner.params["agent"],
                                                ts.runner, test_mode=False)
                     ts = ts.replace(runner=rs,
@@ -569,6 +835,17 @@ def run_sequential(exp: Experiment, logger: Logger,
                                     episode=ts.episode + cfg.batch_size_run)
                     if sync_stages:
                         jax.block_until_ready(rs.t_env)
+                    return ts, stats
+                try:
+                    with timer.stage("rollout"):
+                        # host-buffer rollouts insert into host RAM
+                        # inside fn — not replayable in place
+                        ts, stats = _dispatch("dispatch.rollout", _roll,
+                                              ts,
+                                              retryable=not exp.host_buffer)
+                except watchdog.DispatchFailed as df:
+                    _dispatch_ladder(df)
+                    continue
                 t_env += steps_per_rollout
                 episode += cfg.batch_size_run
                 buffer_filled = min(buffer_filled + cfg.batch_size_run,
@@ -580,12 +857,28 @@ def run_sequential(exp: Experiment, logger: Logger,
                 else:
                     can = buffer_filled >= cfg.batch_size
                 if can and episode >= cfg.accumulated_episodes:
-                    key, k_sample = jax.random.split(key)
-                    with timer.stage("train"):
+                    key2, k_sample = jax.random.split(key)
+
+                    # NB: not named `_train` — graftlint's traced-region
+                    # discovery is name-keyed per module, and `_train` is
+                    # the lax.cond branch inside superstep_program
+                    def _train_once(ts=ts, k_sample=k_sample):
                         ts, info = train_iter(ts, k_sample,
                                               jnp.asarray(t_env))
                         if sync_stages:
                             jax.block_until_ready(info["loss"])
+                        return ts, info
+                    try:
+                        with timer.stage("train"):
+                            # host-buffer sampling advances the host RNG
+                            # inside fn — not replayable in place
+                            ts, info = _dispatch(
+                                "dispatch.train", _train_once, ts,
+                                retryable=not exp.host_buffer)
+                    except watchdog.DispatchFailed as df:
+                        _dispatch_ladder(df)
+                        continue
+                    key = key2
                     train_infos.append(info)
             # shared accounting for both loop shapes: ONE stats push per
             # dispatch (per-rollout (B,) or stacked (K, B) — the
@@ -593,10 +886,26 @@ def run_sequential(exp: Experiment, logger: Logger,
             # block on the dispatch from two back (TPU executes in
             # dispatch order, so this caps live episode batches while
             # still double-buffering host↔device)
-            train_acc.push(stats)
+            # the accumulator push folds with a blocking device fetch
+            # every FOLD_EVERY rollouts — a sync point like any other
+            try:
+                _sync_point("fetch.train_stats",
+                            lambda: train_acc.push(stats))
+            except watchdog.DispatchFailed as df:
+                _dispatch_ladder(df, can_degrade=False)
+                continue
             inflight.append(stats.epsilon)
             if len(inflight) > 2:
-                jax.block_until_ready(inflight.popleft())
+                # the steady-state blocking point of the async loop: a
+                # device-side wedge surfaces HERE, not at the dispatch
+                # call
+                try:
+                    _sync_point("dispatch.wait",
+                                lambda: jax.block_until_ready(
+                                    inflight.popleft()))
+                except watchdog.DispatchFailed as df:
+                    _dispatch_ladder(df, can_degrade=False)
+                    continue
             tracer.tick(logger)
 
             # train-stat cadence: runner_log_interval, epsilon alongside
@@ -605,8 +914,15 @@ def run_sequential(exp: Experiment, logger: Logger,
             # fires every iteration, and its blocking stat fetch then overlaps
             # the already-enqueued train step instead of serializing it.
             if t_env - last_runner_log_t >= cfg.runner_log_interval:
-                train_acc.flush(logger, t_env)
-                logger.log_stat("epsilon", train_acc.epsilon, t_env)
+                def _flush_train_stats():
+                    train_acc.flush(logger, t_env)
+                    # cached by the flush's own fold — no second fetch
+                    logger.log_stat("epsilon", train_acc.epsilon, t_env)
+                try:
+                    _sync_point("fetch.train_stats", _flush_train_stats)
+                except watchdog.DispatchFailed as df:
+                    _dispatch_ladder(df, can_degrade=False)
+                    continue
                 last_runner_log_t = t_env
 
             # ---------------- test cadence (reference :240-256) ----------------
@@ -618,15 +934,44 @@ def run_sequential(exp: Experiment, logger: Logger,
                     f"Time passed: {time_str(time.time() - start_time)}")
                 last_time, last_T = time.time(), t_env
 
-                with timer.stage("test"):
-                    for _ in range(n_test_runs):
-                        rs, _, s = rollout(ts.learner.params["agent"], ts.runner,
-                                           test_mode=True)
-                        ts = ts.replace(runner=rs)
-                        test_acc.push(s)
-                        # Q10: flush only on the exact rounded quota
-                        if test_acc.n_episodes == test_quota:
-                            test_acc.flush(logger, t_env, prefix="test_")
+                try:
+                    with timer.stage("test"):
+                        for _ in range(n_test_runs):
+                            # one _dispatch per rollout (stamp + hook +
+                            # retry) — a single stamp spanning all
+                            # n_test_runs (plus the flush's sink I/O)
+                            # would overrun a per-dispatch-sized timeout
+                            # on a perfectly healthy test cadence
+                            def _test_roll(ts=ts):
+                                rs, _, s = rollout(
+                                    ts.learner.params["agent"], ts.runner,
+                                    test_mode=True)
+                                return rs, s
+                            rs, s = _dispatch("dispatch.test", _test_roll,
+                                              ts)
+                            ts = ts.replace(runner=rs)
+                            # the push's periodic device fold is a
+                            # blocking fetch like the train-side one —
+                            # stamped + routed the same way (its
+                            # DispatchFailed lands in the except below)
+                            _sync_point("fetch.test_stats",
+                                        lambda s=s: test_acc.push(s))
+                            # Q10: flush only on the exact rounded quota
+                            # (the flush fetch is a sync point; its
+                            # DispatchFailed lands in the except below)
+                            if test_acc.n_episodes == test_quota:
+                                _sync_point(
+                                    "fetch.test_stats",
+                                    lambda: test_acc.flush(logger, t_env,
+                                                           prefix="test_"))
+                except watchdog.DispatchFailed as df:
+                    # drop the partial cadence: a leftover sub-quota
+                    # accumulation would miss the exact-quota flush on
+                    # every later cadence; degrading can't help a test
+                    # rollout, only restore can
+                    test_acc = StatsAccumulator()
+                    _dispatch_ladder(df, can_degrade=False)
+                    continue
                 last_test_t = t_env
 
             # ---------------- animation cadence (reference :258-263) -----------
@@ -646,11 +991,51 @@ def run_sequential(exp: Experiment, logger: Logger,
 
             # ---------------- save cadence (reference :265-279) ----------------
             if cfg.save_model and (t_env - last_save_t) >= cfg.save_model_interval:
-                save_to = save_checkpoint(model_dir, t_env, ts)
-                log.info(f"Saving models to {save_to}")
-                if res.keep_last:
-                    prune_checkpoints(model_dir, res.keep_last, res.keep_every)
-                last_save_t = t_env
+                # watchdog covers the (possibly multi-host-collective)
+                # write; transient gather/filesystem faults retry with
+                # backoff — deterministic errors still propagate. The
+                # stamp wraps EACH attempt, not the whole retry loop: a
+                # dispatch_timeout sized for one save must not be eaten
+                # by attempt 1's failure + backoff sleep and then
+                # misdiagnose a succeeding attempt 2 as a stall
+                def _save_once():
+                    with _watched("checkpoint.save", ts):
+                        # this cadence runs in the tail of the very
+                        # iteration whose stall fired it (the guard poll
+                        # at the loop top comes later) — the watchdog's
+                        # emergency save may well hold the lock
+                        if not _acquire_save_lock("save cadence"):
+                            return None
+                        try:
+                            return save_checkpoint(
+                                model_dir, t_env, ts,
+                                gather_retries=res.dispatch_retries,
+                                gather_backoff_s=res.retry_backoff_s)
+                        finally:
+                            save_lock.release()
+                # retry only single-process: in multi-host the save is a
+                # lockstep collective sequence, and a ONE-SIDED transient
+                # failure (say process 0's file write) retried on that
+                # process alone would re-enter barriers its peers already
+                # left — deadlock or a cross-step checkpoint. Symmetric
+                # transport faults are retried one level down (the
+                # per-leaf allgather in utils/checkpoint.py, in lockstep).
+                save_to = watchdog.retry_call(
+                    _save_once,
+                    attempts=(1 + res.dispatch_retries
+                              if jax.process_count() == 1 else 1),
+                    backoff_s=res.retry_backoff_s,
+                    label="checkpoint.save")
+                if save_to is not None:
+                    log.info(f"Saving models to {save_to}")
+                    if res.keep_last:
+                        prune_checkpoints(model_dir, res.keep_last,
+                                          res.keep_every)
+                    # advance the cadence only on a real save: a
+                    # lock-skipped attempt (None) retries next iteration
+                    # instead of silently widening the data-loss window
+                    # by a full save interval right after a stall event
+                    last_save_t = t_env
 
             # ---------------- log cadence (reference :283-286) ------------------
             if (t_env - last_log_t) >= cfg.log_interval:
@@ -661,8 +1046,21 @@ def run_sequential(exp: Experiment, logger: Logger,
                     # the save cadence: the checkpoint written just above
                     # (params finite by construction — tripped steps are
                     # no-ops) is the state the restore wants.
-                    flags = np.asarray(jax.device_get(
-                        [i["all_finite"] for i in train_infos]))
+                    # ONE stamped region for the whole cadence fetch
+                    # (flags + the last info row): a wedge surfacing at
+                    # either device_get must fire the watchdog, and a
+                    # transient error routes through the ladder (the
+                    # fetched-from state is suspect — restore only)
+                    def _fetch_infos():
+                        flags = np.asarray(jax.device_get(
+                            [i["all_finite"] for i in train_infos]))
+                        return flags, jax.device_get(train_infos[-1])
+                    try:
+                        flags, last = _sync_point("fetch.train_infos",
+                                                  _fetch_infos)
+                    except watchdog.DispatchFailed as df:
+                        _dispatch_ladder(df, can_degrade=False)
+                        continue
                     for ok in flags:
                         if ok:
                             nonfinite_streak = 0
@@ -678,7 +1076,6 @@ def run_sequential(exp: Experiment, logger: Logger,
                             f"since last log (streak={nonfinite_streak}, "
                             f"total={nonfinite_total}); parameter updates "
                             f"were skipped")
-                    last = jax.device_get(train_infos[-1])
                     for k in ("loss", "grad_norm", "td_error_abs",
                               "q_taken_mean", "target_mean"):
                         logger.log_stat(k, float(last[k]), t_env)
@@ -707,23 +1104,16 @@ def run_sequential(exp: Experiment, logger: Logger,
                             f"nonfinite_tolerance={res.nonfinite_tolerance}; "
                             f"restoring last good checkpoint {dirname} "
                             f"(restore {restores + 1}/{res.max_restores})")
-                        ts = load_checkpoint(dirname, ts, verify=False)
-                        ts = ts.replace(runner=ts.runner.replace(
-                            t_env=jnp.asarray(step, jnp.int32)))
-                        if dp is not None:
-                            ts = dp.shard(ts)
-                        # re-sync every host-side mirror of device state
-                        t_env = step
-                        episode = int(jax.device_get(ts.episode))
-                        if not exp.host_buffer:
-                            buffer_filled = int(jax.device_get(
-                                ts.buffer.episodes_in_buffer))
-                        inflight.clear()
-                        last_test_t = last_log_t = t_env
-                        last_runner_log_t = last_save_t = t_env
+                        _restore_checkpoint(dirname, step)
                         restores += 1
                         nonfinite_streak = 0
                         continue
+                if dispatch_faults:
+                    # ladder visibility: cumulative transient dispatch
+                    # errors (in-place retries included); per-escalation
+                    # counters land in _dispatch_ladder as they happen
+                    logger.log_stat("dispatch_faults", dispatch_faults,
+                                    t_env)
                 logger.log_stat("episode", episode, t_env)
                 # wall-clock throughput including everything (train, logging,
                 # cadences) — the honest live rate; the async loop makes the
@@ -741,17 +1131,74 @@ def run_sequential(exp: Experiment, logger: Logger,
                 last_log_t = t_env
 
     finally:
+        # stop the watchdog FIRST: the hard-exit grace timer must not be
+        # able to kill the process while the orderly emergency checkpoint
+        # below is being written
+        if wd is not None:
+            wd.stop()
         guard.uninstall()
 
     if guard.triggered:
         # ---- preemption path: lose at most one iteration ---------------
+        stall = wd.take_diagnosis() if wd is not None else None
+        if stall is not None:
+            log.warning(f"watchdog: {stall.message()} — the stalled call "
+                        f"eventually returned; exiting with the diagnosis "
+                        f"persisted to {model_dir}/stall_diagnosis.json")
         log.warning(f"shutdown requested ({guard.signame}) at "
                     f"t_env={t_env} — stopping gracefully")
         if cfg.save_model and res.emergency_checkpoint:
-            save_to = save_checkpoint(model_dir, t_env, ts)
-            if res.keep_last:
-                prune_checkpoints(model_dir, res.keep_last, res.keep_every)
-            log.info(f"emergency checkpoint saved to {save_to}")
+            # a watchdog-thread emergency save may still be mid-write if
+            # wd.stop()'s bounded join gave up on it — both stage into
+            # the same tmp.<t_env> directory, and an unbounded wait
+            # would hang the exit forever (the watchdog and its grace
+            # timer are already stopped)
+            if _acquire_save_lock("preemption exit"):
+                save_to = None
+                # the watchdog and its grace timer are stopped, so this
+                # save is the one device-facing call left with no bound:
+                # wedged device→host fetches block without raising and
+                # retry_call only bounds failures — arm a hard deadline
+                # (watchdog-armed runs only: dispatch_timeout unset
+                # keeps today's behavior) so a wedged backend costs the
+                # stall exit code, not a silent forever-hang in the
+                # exit path
+                deadline = (watchdog.ExitDeadline(
+                                max(res.stall_grace_s, 60.0),
+                                res.stall_exit_code,
+                                label="preemption-exit emergency "
+                                      "checkpoint")
+                            if wd is not None else nullcontext())
+                try:
+                    # same single-process-only retry policy as the
+                    # cadence save (a one-sided retry of the lockstep
+                    # multi-host collective would deadlock its peers) —
+                    # and an orderly preemption exit must STAY orderly:
+                    # a save that still fails falls back to the newest
+                    # published checkpoint instead of turning the
+                    # exit-0 resume hint into a crash
+                    with deadline:
+                        save_to = watchdog.retry_call(
+                            lambda: save_checkpoint(
+                                model_dir, t_env, ts,
+                                gather_retries=res.dispatch_retries,
+                                gather_backoff_s=res.retry_backoff_s),
+                            attempts=(1 + res.dispatch_retries
+                                      if jax.process_count() == 1 else 1),
+                            backoff_s=res.retry_backoff_s,
+                            label="checkpoint.emergency")
+                except Exception:  # noqa: BLE001 — exit path stays orderly
+                    log.exception(
+                        "emergency checkpoint failed on the preemption "
+                        "exit — resume falls back to the newest "
+                        "published checkpoint")
+                finally:
+                    save_lock.release()
+                if save_to is not None:
+                    if res.keep_last:
+                        prune_checkpoints(model_dir, res.keep_last,
+                                          res.keep_every)
+                    log.info(f"emergency checkpoint saved to {save_to}")
         log.info(f"resume with checkpoint_path={model_dir} (newest valid "
                  f"step selected automatically)")
     else:

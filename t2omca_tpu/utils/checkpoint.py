@@ -92,8 +92,15 @@ def _fsync_path(path: str) -> None:
         os.close(fd)
 
 
-def save_checkpoint(path: str, t_env: int, state: Any) -> str:
+def save_checkpoint(path: str, t_env: int, state: Any,
+                    gather_retries: int = 2,
+                    gather_backoff_s: float = 0.5) -> str:
     """Write ``<path>/<t_env>/{state.msgpack, meta.json}`` crash-safely.
+
+    ``gather_retries``/``gather_backoff_s`` bound the per-leaf allgather
+    retry on the multi-host path (defaults mirror
+    ``resilience.dispatch_retries``/``retry_backoff_s`` — the driver
+    threads its configured values through).
 
     The write is staged in ``<path>/tmp.<t_env>``: state bytes + fsync,
     sidecar (format version, replay obs layout, sha256 + byte count of the
@@ -124,9 +131,19 @@ def save_checkpoint(path: str, t_env: int, state: Any) -> str:
     process, orbax-style) remains the escape hatch if even the one-leaf
     transient ever dominates."""
     d = os.path.join(path, str(int(t_env)))
+    # fault-injection point (docs/RESILIENCE.md §4): the gather-to-host
+    # step — the multi-host allgather sequence below, or the plain
+    # device_get serialize on one process. Raising a transient error here
+    # simulates a dropped/flaky collective; the driver's save cadence
+    # wraps this whole function in utils.watchdog.retry_call, so the save
+    # is retried with backoff instead of killing the run.
+    resilience.fire("collective.gather", t_env=int(t_env),
+                    multihost=jax.process_count() > 1)
     if jax.process_count() > 1:
         import numpy as _np
         from jax.experimental import multihost_utils
+
+        from .watchdog import retry_call
 
         # quiesce + align before the host-driven collective sequence: the
         # driver dispatches asynchronously, so train-step collectives
@@ -150,8 +167,18 @@ def save_checkpoint(path: str, t_env: int, state: Any) -> str:
                 # local shard already holds the value — no collective
                 return _np.asarray(x) if writer else None
             # branch choice depends only on shardings — identical on every
-            # process, so the collectives stay in lockstep
-            g = multihost_utils.process_allgather(x, tiled=True)
+            # process, so the collectives stay in lockstep. Transient
+            # transport faults (the gloo EnforceNotMet class) retry with
+            # the same deterministic policy on every process: the error is
+            # symmetric (the collective fails on all participants), so the
+            # peers re-enter the retried gather in lockstep too — a
+            # one-sided loss would desync and is exactly what the driver's
+            # watchdog (stamped around this save) then catches as a stall.
+            g = retry_call(
+                lambda: multihost_utils.process_allgather(x, tiled=True),
+                attempts=1 + max(int(gather_retries), 0),
+                backoff_s=gather_backoff_s,
+                label="checkpoint.process_allgather")
             if not writer:
                 return None          # freed now, not at function exit
             gathered_bytes[0] += g.nbytes

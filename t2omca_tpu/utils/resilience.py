@@ -49,6 +49,37 @@ logger = logging.getLogger(__name__)
 #:   ``driver.iteration``    t_env=<int>, guard=<ShutdownGuard|None>
 #:       top of every run_sequential iteration — deliver a signal or trip
 #:       the guard at an exact env-step.
+#:   ``dispatch.superstep``  t_env=<int>, attempt=<int>, k=<int>
+#:       before EACH attempt of the fused K-iteration dispatch (run.py
+#:       `_dispatch`) — sleep here to simulate a hung dispatch (the
+#:       watchdog must fire), raise a transient-classified error to
+#:       exercise retry/backoff and the degradation ladder.
+#:   ``dispatch.rollout`` / ``dispatch.train``   t_env=<int>, attempt=<int>
+#:       same, for the classic three-program loop's two dispatches.
+#:   ``dispatch.test``       t_env=<int>, attempt=<int>
+#:       same, for each test-cadence evaluation rollout.
+#:   ``dispatch.wait``       t_env=<int>
+#:       before the run-ahead ``block_until_ready`` — the steady-state
+#:       blocking point where async device faults surface when
+#:       per-stage sync is off; transient errors route to the ladder's
+#:       restore rung (no in-place retry is possible at a sync point).
+#:   ``fetch.train_infos``   t_env=<int>
+#:       before the log-cadence device→host fetch of the accumulated
+#:       train-info rows (non-finite flags + last stats row) — same
+#:       sync-point routing as ``dispatch.wait``.
+#:   ``fetch.train_stats`` / ``fetch.test_stats``   t_env=<int>
+#:       before each StatsAccumulator device fetch (the per-push fold
+#:       and the runner-log / test-quota flushes) — same sync-point
+#:       routing as ``dispatch.wait``.
+#:   ``collective.gather``   t_env=<int>, multihost=<bool>
+#:       inside save_checkpoint's retried gather-to-host step (before the
+#:       multi-host process_allgather sequence, or before the
+#:       single-process device_get) — raise to simulate a dropped/flaky
+#:       collective; the driver's save cadence retries transient errors.
+#:   ``backend.init``        attempt=<int>
+#:       inside each retried jax.distributed.initialize attempt
+#:       (parallel/distributed.py) — raise a transient error to exercise
+#:       the init retry that de-flakes the gloo rendezvous.
 _FAULTS: Dict[str, List[Callable]] = {}
 
 

@@ -95,7 +95,8 @@ def test_backend_probe_bound_emits_record():
     timeout — the bounded SUBPROCESS probe must land a parseable,
     structured error record first (probe timeout <= 0 forces the
     timed-out branch deterministically; the retry must show in the
-    message)."""
+    message, and the record must attribute the failure to the TIMEOUT
+    phase, not a backend error the child never got to raise)."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
@@ -106,11 +107,68 @@ def test_backend_probe_bound_emits_record():
     assert proc.returncode == 1
     rec = json.loads(proc.stdout.strip().splitlines()[-1])
     assert rec["value"] is None
-    assert rec["phase"] == "backend_init"
+    assert rec["phase"] == "timeout"
     assert "probe bound" in rec["error"]
     assert "attempt 2/2" in rec["error"]            # one retry happened
 
 
+def test_probe_timeout_kills_and_reaps_child():
+    """The probe's timeout path must leave NO child behind: the wedged
+    child is killed AND reaped (a zombie per probe would accumulate
+    against the pid limit in a soak loop). In-process against
+    probe_backend with a sleeping stand-in for the wedged init — fast,
+    no jax import in the child."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    pids = []
+    real_popen = bench.subprocess.Popen
+
+    class RecordingPopen(real_popen):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            pids.append(self.pid)
+
+    bench.subprocess.Popen = RecordingPopen
+    try:
+        failure = bench.probe_backend(
+            2.0, _cmd=[sys.executable, "-c", "import time; time.sleep(300)"])
+    finally:
+        bench.subprocess.Popen = real_popen
+    assert failure is not None
+    assert failure["phase"] == "timeout"
+    assert "attempt 2/2" in failure["error"]
+    assert len(pids) == 2                           # both attempts spawned
+    for pid in pids:
+        # killed AND reaped: a reaped pid is gone (ProcessLookupError);
+        # a zombie still accepts signal 0
+        with pytest.raises(ProcessLookupError):
+            os.kill(pid, 0)
+
+
+def test_probe_child_error_reports_backend_init_phase():
+    """A child that starts but FAILS (real backend error) must be
+    attributed to the backend_init phase with its stderr in the record —
+    distinct from the timeout shape above."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    failure = bench.probe_backend(
+        30.0, _cmd=[sys.executable, "-c",
+                    "import sys; print('tunnel says no', file=sys.stderr); "
+                    "sys.exit(3)"])
+    assert failure is not None
+    assert failure["phase"] == "backend_init"
+    assert "tunnel says no" in failure["error"]
+
+
+@pytest.mark.slow   # subprocess + fused-program jit (~33 s, the heaviest
+                    # remaining in-gate bench test); the round driver runs
+                    # `bench.py --smoke --superstep 1` directly anyway
 def test_superstep_bench_reports_amortized_rate():
     """--superstep K: the fused-dispatch measurement. K=4 exercises the
     scan and the warm dispatch must have opened the train gate; the K=1

@@ -1,0 +1,187 @@
+"""Chaos soak (docs/RESILIENCE.md §5; runner: ``scripts/chaos.sh``):
+cycle every fault-injection hook point against the real driver and assert
+the ONE invariant that matters for production: **whatever happens, the
+run ends in a resumable state** — a ``verify_checkpoint``-passing
+checkpoint on disk that a fresh driver can load and carry to t_max.
+
+Each scenario is a full ``run()`` on the tiny CPU config (fresh compile),
+so the module is ``slow``-marked and additionally carries the ``chaos``
+marker so the soak runner can select exactly this battery:
+
+    bash scripts/chaos.sh [N]     # N cycles of the whole battery
+"""
+
+import glob
+import os
+import signal
+import time
+
+import jax
+import pytest
+
+from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,
+                               ResilienceConfig, TrainConfig, sanity_check)
+from t2omca_tpu.run import run
+from t2omca_tpu.utils import resilience
+from t2omca_tpu.utils.checkpoint import find_checkpoint, verify_checkpoint
+from t2omca_tpu.utils.logging import Logger
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos, pytest.mark.faultinject]
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leaks():
+    resilience.clear_faults()
+    yield
+    resilience.clear_faults()
+
+
+def chaos_cfg(results_dir, **res_kw):
+    # dispatch_timeout carries wide headroom over a warm tiny-config
+    # dispatch so a loaded CI box cannot trip it spuriously; the injected
+    # hang (2.5 s below) still dwarfs it
+    res = dict(dispatch_timeout=0.75, stall_grace_s=0.0,
+               dispatch_retries=1, retry_backoff_s=0.01, max_restores=2)
+    res.update(res_kw)
+    return sanity_check(TrainConfig(
+        t_max=60, batch_size_run=2, batch_size=4, test_interval=1_000_000,
+        test_nepisode=2, log_interval=12, runner_log_interval=12,
+        save_model=True, save_model_interval=12, superstep=2,
+        local_results_path=str(results_dir), use_tensorboard=False,
+        epsilon_anneal_time=50,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=6),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1),
+        replay=ReplayConfig(buffer_size=8),
+        resilience=ResilienceConfig(**res),
+    ))
+
+
+def _inject_hang():
+    fired = []
+
+    def _hang(t_env, **kw):
+        if t_env >= 24 and not fired:
+            fired.append(t_env)
+            time.sleep(2.5)                    # >> dispatch_timeout
+
+    resilience.register_fault("dispatch.superstep", _hang)
+
+
+def _inject_transient_dispatch():
+    def _flaky(t_env, attempt, **kw):
+        if t_env == 24 and attempt == 1:
+            raise RuntimeError("chaos: connection reset by peer")
+
+    resilience.register_fault("dispatch.superstep", _flaky)
+
+
+def _inject_persistent_fused():
+    def _always(t_env, **kw):
+        raise RuntimeError("chaos: fused dispatch socket closed")
+
+    resilience.register_fault("dispatch.superstep", _always)
+
+
+def _inject_transient_wait():
+    # the production steady-state blocking point: an async device fault
+    # surfaces at the run-ahead block_until_ready, not at the dispatch
+    # call — must route to the ladder's restore rung, not kill the run
+    seen = []
+
+    def _wait_fault(t_env, **kw):
+        seen.append(t_env)
+        if len(seen) == 1:
+            raise RuntimeError("chaos: connection reset by peer")
+
+    resilience.register_fault("dispatch.wait", _wait_fault)
+
+
+def _inject_flaky_gather():
+    seen = []
+
+    def _gather(t_env, **kw):
+        seen.append(t_env)
+        if len(seen) == 1:
+            raise RuntimeError("chaos: collective timed out")
+
+    resilience.register_fault("collective.gather", _gather)
+
+
+def _inject_checkpoint_crash():
+    seen = []
+
+    def _crash(dirname, t_env, **kw):
+        seen.append(t_env)
+        if len(seen) == 2:                     # the SECOND save dies
+            raise RuntimeError("chaos: crash mid-checkpoint")
+
+    resilience.register_fault("checkpoint.staged", _crash)
+
+
+def _inject_sigterm():
+    def _preempt(t_env, guard, **kw):
+        if t_env >= 24:
+            signal.raise_signal(signal.SIGTERM)
+
+    resilience.register_fault("driver.iteration", _preempt)
+
+
+#: (name, injector, may_raise) — may_raise names the exception type a
+#: scenario is ALLOWED to kill the run with; resumability must hold
+#: either way.
+SCENARIOS = [
+    ("hang_at_superstep", _inject_hang, None),
+    ("transient_dispatch", _inject_transient_dispatch, None),
+    ("persistent_fused_degrades", _inject_persistent_fused, None),
+    ("transient_runahead_wait", _inject_transient_wait, None),
+    ("flaky_checkpoint_gather", _inject_flaky_gather, None),
+    ("crash_mid_checkpoint", _inject_checkpoint_crash, RuntimeError),
+    ("sigterm_preemption", _inject_sigterm, None),
+]
+
+
+@pytest.mark.parametrize("name,inject,may_raise",
+                         SCENARIOS, ids=[s[0] for s in SCENARIOS])
+def test_chaos_run_always_ends_resumable(tmp_path, name, inject, may_raise):
+    results = tmp_path / name
+    cfg = chaos_cfg(results)
+    inject()
+    try:
+        run(cfg, Logger())
+    except Exception as e:              # noqa: BLE001 — asserted below
+        assert may_raise is not None and isinstance(e, may_raise), \
+            f"scenario {name} must not kill the run with {e!r}"
+    finally:
+        resilience.clear_faults()
+
+    # THE invariant: a valid checkpoint exists, newest-first selection
+    # skips anything torn, and a fresh fault-free driver resumes it to
+    # the original target
+    model_dirs = glob.glob(os.path.join(results, "models", "*"))
+    assert model_dirs, f"scenario {name} left no checkpoint directory"
+    found = find_checkpoint(model_dirs[0])
+    assert found is not None, f"scenario {name} left no valid checkpoint"
+    dirname, step = found
+    assert verify_checkpoint(dirname)
+    assert 0 < step <= cfg.t_max + 2 * cfg.superstep * 12
+
+    ts = run(cfg.replace(checkpoint_path=model_dirs[0]), Logger())
+    assert int(jax.device_get(ts.runner.t_env)) > cfg.t_max, \
+        f"scenario {name}: resume did not reach t_max"
+
+
+def test_chaos_scenarios_cover_every_hook_point():
+    """The battery must keep covering each documented injection point as
+    hooks are added (a new hook point without a chaos scenario is a
+    regression in this file)."""
+    import inspect
+    covered = set()
+    for _, inject, _ in SCENARIOS:
+        covered.update(
+            line.split('"')[1]
+            for line in inspect.getsource(inject).splitlines()
+            if "register_fault(" in line)
+    assert {"dispatch.superstep", "dispatch.wait", "collective.gather",
+            "checkpoint.staged", "driver.iteration"} <= covered

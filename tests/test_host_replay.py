@@ -97,6 +97,26 @@ def test_host_buffer_roundtrip_and_weights():
     assert counts[np.asarray(idx)[0]] == counts.max()
 
 
+def test_host_buffer_drop_pending_update():
+    """A deferred priority update abandoned by a checkpoint restore must
+    never reach the sum-tree — the refs belong to the rolled-back train
+    step (``run._restore_checkpoint`` calls ``drop_pending_update``);
+    flushing them would stamp the abandoned computation's |TD| onto the
+    restored buffer's priorities."""
+    import jax.numpy as jnp
+    buf = _buf()
+    buf.insert_episode_batch(_mk_batch(4, seed=5))
+    _, idx, _ = buf.sample(3, t_env=0)
+    total_before = buf._tree.total()
+    buf.defer_priority_update(np.asarray(idx),
+                              jnp.full((len(np.asarray(idx)),), 1e6),
+                              jnp.asarray(True))
+    buf.drop_pending_update()
+    assert buf._pending_update is None
+    buf.flush_priority_updates()            # must be a no-op now
+    assert buf._tree.total() == pytest.approx(total_before)
+
+
 def test_host_buffer_ring_wraparound():
     buf = _buf(capacity=4)
     buf.insert_episode_batch(_mk_batch(3, seed=2))

@@ -16,11 +16,13 @@ import sys
 import numpy as np
 import pytest
 
-# two fresh interpreters + gloo rendezvous + full program compiles per
-# test (~200 s on the 2-core CI box) — far outside the tier-1 870 s
-# budget; run explicitly via `-m slow` or with no marker filter
-pytestmark = pytest.mark.slow
+from t2omca_tpu.parallel import maybe_initialize_distributed
+from t2omca_tpu.utils import resilience
 
+# two fresh interpreters + gloo rendezvous + full program compiles per
+# 2-process test (~200 s on the 2-core CI box) — far outside the tier-1
+# 870 s budget; those carry @pytest.mark.slow individually. The init
+# retry/backoff tests below are in-gate (host-only, milliseconds).
 REPO = os.path.join(os.path.dirname(__file__), "..")
 
 
@@ -66,12 +68,14 @@ def _parse(outs, tag):
     return vals
 
 
+@pytest.mark.slow
 def test_two_process_train_step_agrees():
     losses = _parse(_launch_workers(), "LOSS")
     # identical loss on both processes: the psum crossed the boundary
     np.testing.assert_allclose(losses[0], losses[1], rtol=0, atol=0)
 
 
+@pytest.mark.slow
 def test_two_process_checkpoint_restores_single_process(tmp_path):
     """VERDICT r4 item 6: a checkpoint SAVED FROM the 2-process mesh
     (gather-to-process-0 collective in save_checkpoint) restores in a
@@ -99,3 +103,224 @@ def test_two_process_checkpoint_restores_single_process(tmp_path):
     ts = load_learner_state(dirname, exp.init_train_state(0))
     metric = eval_fingerprint(exp, ts.learner.params["agent"])
     np.testing.assert_allclose(metric, evals[0], rtol=0, atol=0)
+
+
+# ---------------------------------------------------------------------------
+# init retry/backoff (in-gate: host-only, the real initialize is stubbed).
+# The 2-process rendezvous used to die ~50% of the time on this box to a
+# transient gloo EnforceNotMet (CHANGES.md); maybe_initialize_distributed
+# now retries transient-classified failures with backoff
+# (utils.watchdog.retry_call) and the `backend.init` injection point makes
+# the flake reproducible on demand.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leaks():
+    resilience.clear_faults()
+    yield
+    resilience.clear_faults()
+
+
+def test_init_retries_transient_rendezvous_failure(monkeypatch):
+    """Attempt 1 hits the gloo flake (injected at backend.init), attempt
+    2 succeeds — the job starts instead of dying at step zero. The real
+    initialize must run exactly once (on the surviving attempt)."""
+    calls = []
+    monkeypatch.setattr("jax.distributed.initialize",
+                        lambda **kw: calls.append(kw))
+    attempts = []
+
+    def _flaky(attempt):
+        attempts.append(attempt)
+        if attempt == 1:
+            raise RuntimeError(
+                "Gloo connectFullMesh failed: EnforceNotMet preamble "
+                "size mismatch")
+
+    resilience.register_fault("backend.init", _flaky)
+    assert maybe_initialize_distributed(
+        coordinator_address="localhost:1", num_processes=2, process_id=0,
+        retries=3)
+    assert attempts == [1, 2]
+    assert len(calls) == 1
+    assert calls[0]["num_processes"] == 2
+
+
+def test_init_does_not_retry_deterministic_error(monkeypatch):
+    """A non-transient init error (bad topology) must fail on the FIRST
+    attempt — retrying a deterministic mistake only delays the real
+    diagnosis."""
+    calls = []
+
+    def _bad(**kw):
+        calls.append(kw)
+        raise RuntimeError("invalid process id -7")
+
+    monkeypatch.setattr("jax.distributed.initialize", _bad)
+    with pytest.raises(RuntimeError, match="invalid process id"):
+        maybe_initialize_distributed(coordinator_address="localhost:1",
+                                     num_processes=2, process_id=0,
+                                     retries=3)
+    assert len(calls) == 1
+
+
+def test_init_exhausted_retries_reraises(monkeypatch):
+    """A persistent transient failure exhausts the attempts and surfaces
+    the LAST error unmodified (callers keep their except clauses).
+    ``retries`` counts attempts BEYOND the first (the resilience.
+    dispatch_retries convention): retries=1 -> 2 total attempts."""
+    calls = []
+
+    def _always_flaky(**kw):
+        calls.append(kw)
+        raise RuntimeError("connection reset by peer")
+
+    monkeypatch.setattr("jax.distributed.initialize", _always_flaky)
+    with pytest.raises(RuntimeError, match="connection reset"):
+        maybe_initialize_distributed(coordinator_address="localhost:1",
+                                     num_processes=2, process_id=0,
+                                     retries=1)
+    assert len(calls) == 2
+
+
+def test_init_retries_zero_means_single_attempt(monkeypatch):
+    """retries=0 disables the retry entirely — one attempt, matching
+    resilience.dispatch_retries=0 in the driver."""
+    calls = []
+
+    def _always_flaky(**kw):
+        calls.append(kw)
+        raise RuntimeError("connection reset by peer")
+
+    monkeypatch.setattr("jax.distributed.initialize", _always_flaky)
+    with pytest.raises(RuntimeError, match="connection reset"):
+        maybe_initialize_distributed(coordinator_address="localhost:1",
+                                     num_processes=2, process_id=0,
+                                     retries=0)
+    assert len(calls) == 1
+
+
+def test_init_nonnumeric_env_retries_falls_back(monkeypatch):
+    """A non-numeric T2OMCA_INIT_RETRIES must not crash the job at
+    startup — it is ignored with a warning and the default (2 retries,
+    3 attempts) applies."""
+    monkeypatch.setenv("T2OMCA_INIT_RETRIES", "lots")
+    calls = []
+
+    def _always_flaky(**kw):
+        calls.append(kw)
+        raise RuntimeError("connection reset by peer")
+
+    monkeypatch.setattr("jax.distributed.initialize", _always_flaky)
+    with pytest.raises(RuntimeError, match="connection reset"):
+        maybe_initialize_distributed(coordinator_address="localhost:1",
+                                     num_processes=2, process_id=0)
+    assert len(calls) == 3
+
+
+def test_init_already_initialized_stays_idempotent(monkeypatch):
+    """The runtime's own double-init error still reads as success — and
+    is never retried."""
+    calls = []
+
+    def _dup(**kw):
+        calls.append(kw)
+        raise RuntimeError("jax.distributed is already initialized")
+
+    monkeypatch.setattr("jax.distributed.initialize", _dup)
+    assert maybe_initialize_distributed(coordinator_address="localhost:1",
+                                        num_processes=2, process_id=0,
+                                        retries=3)
+    assert len(calls) == 1
+
+
+def test_init_only_once_message_stays_idempotent(monkeypatch):
+    """jax 0.4.37 phrases the double-init error 'distributed.initialize
+    should only be called once.' (no 'already' anywhere) — it must still
+    read as success on a pre-initialized runtime."""
+    calls = []
+
+    def _dup(**kw):
+        calls.append(kw)
+        raise RuntimeError("distributed.initialize should only be "
+                           "called once.")
+
+    monkeypatch.setattr("jax.distributed.initialize", _dup)
+    assert maybe_initialize_distributed(coordinator_address="localhost:1",
+                                        num_processes=2, process_id=0,
+                                        retries=3)
+    assert len(calls) == 1
+
+
+def test_init_retry_resets_partial_state(monkeypatch):
+    """jax 0.4.37 assigns global_state.service/.client BEFORE
+    client.connect(), so a transient rendezvous failure leaves the
+    runtime half-initialized and a bare retry would die on the
+    double-init RuntimeError instead of re-attempting. The retry path
+    must tear the partial state down (jax.distributed.shutdown) between
+    attempts so attempt 2 genuinely re-initializes."""
+    st = {"initialized": False, "connects": 0, "shutdowns": 0}
+
+    def _partial_state_init(**kw):
+        if st["initialized"]:
+            raise RuntimeError("distributed.initialize should only be "
+                               "called once.")
+        st["initialized"] = True        # set BEFORE the connect attempt
+        st["connects"] += 1
+        if st["connects"] == 1:
+            raise RuntimeError(
+                "Gloo connectFullMesh failed: EnforceNotMet preamble "
+                "size mismatch")
+
+    def _shutdown():
+        st["initialized"] = False
+        st["shutdowns"] += 1
+
+    monkeypatch.setattr("jax.distributed.initialize", _partial_state_init)
+    monkeypatch.setattr("jax.distributed.shutdown", _shutdown)
+    assert maybe_initialize_distributed(coordinator_address="localhost:1",
+                                        num_processes=2, process_id=0,
+                                        retries=3)
+    assert st["connects"] == 2          # attempt 2 really re-initialized
+    assert st["shutdowns"] == 1         # partial state torn down once
+    assert st["initialized"]            # and the final state is live
+
+
+def test_init_failed_reset_does_not_misread_double_init(monkeypatch):
+    """If the between-attempts teardown fails, the double-init error on a
+    RETRY means this call's own half-initialized runtime — not a
+    pre-initialized one. It must surface as a failure instead of
+    reporting success on a never-connected runtime that would wedge at
+    the first collective."""
+    st = {"initialized": False, "connects": 0}
+
+    def _partial_state_init(**kw):
+        if st["initialized"]:
+            raise RuntimeError("distributed.initialize should only be "
+                               "called once.")
+        st["initialized"] = True        # set BEFORE the connect attempt
+        st["connects"] += 1
+        raise RuntimeError(
+            "Gloo connectFullMesh failed: EnforceNotMet preamble "
+            "size mismatch")
+
+    def _broken_shutdown():
+        raise RuntimeError("cannot shut down a half-connected client")
+
+    monkeypatch.setattr("jax.distributed.initialize", _partial_state_init)
+    monkeypatch.setattr("jax.distributed.shutdown", _broken_shutdown)
+    with pytest.raises(RuntimeError, match="only be called once"):
+        maybe_initialize_distributed(coordinator_address="localhost:1",
+                                     num_processes=2, process_id=0,
+                                     retries=3)
+    assert st["connects"] == 1          # the real connect ran only once
+
+
+def test_init_no_topology_is_a_noop(monkeypatch):
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID", "T2OMCA_MULTIHOST"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setattr("jax.distributed.initialize",
+                        lambda **kw: pytest.fail("must not initialize"))
+    assert not maybe_initialize_distributed()
