@@ -5,5 +5,11 @@
 # new findings, each printed as path:line:col RULE message. Pure AST —
 # no jax import, no backend startup — so it runs in front of the tier-1
 # pytest batch (scripts/t1.sh) at negligible cost.
+#
+# NB for callers: shell options do not propagate upward, so nothing in
+# THIS script can protect `bash scripts/lint.sh | tee log` — the caller
+# must own its pipe status (t1.sh uses `set -o pipefail` +
+# ${PIPESTATUS[0]}). A bare `cmd | tee` reports tee's exit 0 and
+# silently swallows the gate.
 cd "$(dirname "$0")/.." || exit 2
 python -m t2omca_tpu.analysis "$@"

@@ -18,18 +18,33 @@ front of every test batch), so guard names resolve lazily via module
 
 from __future__ import annotations
 
-from .baseline import (DEFAULT_BASELINE, diff_baseline, load_baseline,
-                       save_baseline)
+from .baseline import (DEFAULT_BASELINE, DEFAULT_PROGRAMS, diff_baseline,
+                       load_baseline, load_programs, save_baseline,
+                       save_programs)
 from .graftlint import (HOT_PATH_GLOBS, RULES, Finding, lint_file,
                         lint_package, lint_source)
 
 _GUARD_NAMES = ("compile_budget", "no_transfer", "CompileBudgetExceeded",
                 "CompileEvents")
+#: graftprog/registry surface — resolved lazily like the guards: the
+#: modules are import-light themselves, but anything that *uses* them
+#: pulls in jax, and the lint CLI must stay jax-free
+_PROG_NAMES = {
+    "GP_RULES": "graftprog", "ProgFinding": "graftprog",
+    "ProgramReport": "graftprog", "audit_program": "graftprog",
+    "audit_registry": "graftprog", "compare_reports": "graftprog",
+    "fingerprint_text": "graftprog", "CONST_BYTES_DEFAULT": "graftprog",
+    "AuditProgram": "registry", "AuditContext": "registry",
+    "SkipProgram": "registry", "audit_config": "registry",
+    "audit_context": "registry",
+    "collect_default_programs": "registry",
+}
 
 __all__ = [
-    "DEFAULT_BASELINE", "diff_baseline", "load_baseline", "save_baseline",
+    "DEFAULT_BASELINE", "DEFAULT_PROGRAMS", "diff_baseline",
+    "load_baseline", "load_programs", "save_baseline", "save_programs",
     "HOT_PATH_GLOBS", "RULES", "Finding", "lint_file", "lint_package",
-    "lint_source", *_GUARD_NAMES,
+    "lint_source", *_GUARD_NAMES, *sorted(_PROG_NAMES),
 ]
 
 
@@ -37,4 +52,8 @@ def __getattr__(name: str):
     if name in _GUARD_NAMES:
         from . import guards
         return getattr(guards, name)
+    if name in _PROG_NAMES:
+        import importlib
+        mod = importlib.import_module(f".{_PROG_NAMES[name]}", __name__)
+        return getattr(mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

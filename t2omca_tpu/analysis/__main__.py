@@ -1,25 +1,145 @@
-"""``python -m t2omca_tpu.analysis`` — the graftlint CLI.
+"""``python -m t2omca_tpu.analysis`` — the graftlint/graftprog CLI.
 
-Exit codes (the contract ``scripts/lint.sh`` and the tier-1 gate rely
-on): 0 = no new findings (baselined accepted findings are fine),
-1 = new findings (each printed as ``path:line:col: RULE message``),
-2 = usage/internal error. Stale baseline entries are warned about but
-never fail — re-run with ``--write-baseline`` to tighten the ratchet.
+Exit codes (the contract ``scripts/lint.sh``, ``scripts/t1.sh`` and the
+tier-1 gate rely on): 0 = no new findings (baselined accepted findings
+are fine), 1 = new findings (lint: ``path:line:col: RULE message``;
+``--programs``: ``program: RULE message``), 2 = usage/internal error.
+Stale baseline entries are warned about but never fail — re-run with
+``--write-baseline`` / ``--write-programs`` to tighten the ratchet.
 
-Deliberately jax-free: the lint pass is pure AST and runs in front of
-every test batch, so it must not pay (or depend on) backend startup.
+The default (lint) path is deliberately jax-free: pure AST, runs in
+front of every test batch, must not pay backend startup. ``--programs``
+is the opposite: it lowers (and for the donated hot programs compiles)
+the registered XLA programs on a tiny CPU config — it forces
+``JAX_PLATFORMS=cpu`` and a 2-CPU-device host platform so the audited
+programs (and their checked-in fingerprints, ``analysis/programs.json``)
+are identical on every machine, TPU hosts included.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from collections import Counter
 from pathlib import Path
 
-from .baseline import (DEFAULT_BASELINE, diff_baseline, load_baseline,
-                       save_baseline)
+from .baseline import (DEFAULT_BASELINE, DEFAULT_PROGRAMS, diff_baseline,
+                       load_baseline, load_programs, save_baseline,
+                       save_programs)
 from .graftlint import RULES, lint_package
+
+
+def _pin_cpu_platform() -> None:
+    """Pin the audit to the canonical platform BEFORE jax initializes:
+    CPU backend, and at least the 2 host devices the dp program's fixed
+    mesh needs. The checked-in fingerprints/budgets are for exactly this
+    platform — auditing on whatever backend happens to be attached would
+    produce fiction. A no-op when jax is already imported (in-process
+    callers — the tests — own their platform)."""
+    if "jax" in sys.modules:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+
+
+def _programs_main(args) -> int:
+    if args.write_programs and args.only:
+        # save_programs writes exactly the audited set — a partial
+        # audit would silently drop every unselected entry
+        print("graftprog: error: --write-programs re-baselines the FULL "
+              "program set; it cannot be combined with --only",
+              file=sys.stderr)
+        return 2
+    _pin_cpu_platform()
+    try:
+        from . import graftprog, registry
+        reg = registry.collect_default_programs()
+        for extra in args.program_module:
+            for name, prog in registry.load_programs_from(extra).items():
+                reg[name] = prog
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"graftprog: error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.list_programs:
+        for name, prog in reg.items():
+            what = (f"SKIP ({prog.skip})" if prog.skip is not None else
+                    prog.description)
+            print(f"{name:16s} {'compile' if prog.compile else 'lower':8s}"
+                  f" {what}")
+        return 0
+
+    # resolve the old baseline BEFORE the (minutes-long on a loaded
+    # box) audit: a corrupt/version-mismatched programs.json must be a
+    # fast exit-2 usage error, not a post-audit traceback
+    old = None
+    if args.write_programs and not args.no_baseline:
+        try:
+            old = load_programs(args.programs_baseline)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f"graftprog: error: unreadable baseline "
+                  f"{args.programs_baseline}: {e}", file=sys.stderr)
+            return 2
+
+    import jax
+    compute_dtype = registry.audit_context().compute_dtype
+    try:
+        reports = graftprog.audit_registry(
+            reg, compute_dtype, only=args.only or None)
+    except KeyError as e:
+        print(f"graftprog: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_programs:
+        save_programs(args.programs_baseline, reports,
+                      platform=jax.default_backend(), old=old or {})
+        n = sum(r.skipped is None for r in reports)
+        print(f"graftprog: wrote {n} program entries to "
+              f"{args.programs_baseline}")
+        return 0
+
+    if args.no_baseline:
+        # raw audit: every rule occurrence is a finding, budgets skipped
+        findings = [graftprog.ProgFinding(r.name, rule, m)
+                    for r in reports if r.skipped is None
+                    for rule, msgs in sorted(r.rule_details.items())
+                    for m in msgs]
+        stale = [f"{r.name}: skipped ({r.skipped})"
+                 for r in reports if r.skipped is not None]
+    else:
+        try:
+            base = load_programs(args.programs_baseline)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f"graftprog: error: unreadable baseline "
+                  f"{args.programs_baseline}: {e}", file=sys.stderr)
+            return 2
+        platform = jax.default_backend()
+        if base["platform"] and base["platform"] != platform:
+            print(f"graftprog: warning: baseline is for platform "
+                  f"{base['platform']!r}, running on {platform!r} — "
+                  f"budgets/fingerprints are not comparable, skipping "
+                  f"the ratchet (pin JAX_PLATFORMS=cpu)",
+                  file=sys.stderr)
+            return 0
+        findings, stale = graftprog.compare_reports(reports,
+                                                    base["programs"])
+    for f in findings:
+        print(f.format())
+    for note in stale:
+        print(f"graftprog: warning: stale/skip: {note}", file=sys.stderr)
+    per_rule = Counter(f.rule for f in findings)
+    summary = ", ".join(f"{r}x{c}" if c > 1 else r
+                        for r, c in sorted(per_rule.items()))
+    n_skip = sum(r.skipped is not None for r in reports)
+    print(f"graftprog: {len(reports)} programs audited"
+          + (f" ({n_skip} skipped)" if n_skip else "")
+          + f", {len(findings)} new finding(s)"
+          + (f": {summary}" if summary else ""))
+    return 1 if findings else 0
 
 
 def main(argv=None) -> int:
@@ -47,12 +167,45 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true",
         help="print the rule catalog and exit")
+    prog_group = parser.add_argument_group(
+        "compiled-program audit (graftprog, docs/ANALYSIS.md)")
+    prog_group.add_argument(
+        "--programs", action="store_true",
+        help="audit the registered compiled programs (GP rules + HLO "
+             "budgets) instead of linting source")
+    prog_group.add_argument(
+        "--programs-baseline", type=Path, default=DEFAULT_PROGRAMS,
+        help="program budgets/fingerprints file "
+             "(default: analysis/programs.json)")
+    prog_group.add_argument(
+        "--write-programs", action="store_true",
+        help="accept the measured budgets/fingerprints as the baseline "
+             "(keeps justifications + tolerances; new entries get TODO)")
+    prog_group.add_argument(
+        "--program-module", action="append", default=[], metavar="MOD",
+        help="extra module (dotted path or .py file) whose "
+             "register_audit_programs(ctx) adds programs — the seeded-"
+             "regression test entry point; repeatable")
+    prog_group.add_argument(
+        "--only", action="append", default=[], metavar="NAME",
+        help="audit only the named program(s); repeatable")
+    prog_group.add_argument(
+        "--list-programs", action="store_true",
+        help="print the registered program names and exit")
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        for rule, summary in sorted(RULES.items()):
+        from .graftprog import GP_RULES
+        for rule, summary in sorted({**RULES, **GP_RULES}.items()):
             print(f"{rule}  {summary}")
         return 0
+    # the program-audit flags imply --programs: falling through to the
+    # lint path would silently ignore them (a bare `--write-programs`
+    # after an intended change would exit 0 having written nothing,
+    # and the next gate run would fail GP304 with no hint why)
+    if (args.programs or args.list_programs or args.write_programs
+            or args.program_module or args.only):
+        return _programs_main(args)
 
     root = args.root or Path(__file__).resolve().parents[2]
     try:

@@ -25,9 +25,12 @@ from typing import Dict, List, Sequence, Tuple
 from .graftlint import Finding
 
 BASELINE_VERSION = 1
+PROGRAMS_VERSION = 1
 
 #: default checked-in location, next to this module
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+#: compiled-program budgets/fingerprints (graftprog), same directory
+DEFAULT_PROGRAMS = Path(__file__).resolve().parent / "programs.json"
 
 Key = Tuple[str, str, str]          # (rule, path, code)
 
@@ -92,3 +95,69 @@ def diff_baseline(findings: Sequence[Finding],
     stale = [k for k, e in sorted(baseline.items())
              if len(by_key.get(k, [])) < e["count"]]
     return sorted(new, key=lambda f: (f.path, f.line, f.col)), stale
+
+
+# --------------------------------------------------- program baseline (GP)
+
+def load_programs(path: Path = DEFAULT_PROGRAMS) -> dict:
+    """programs.json -> {"platform": ..., "programs": {name: entry}}.
+    A missing file is an empty baseline (every registered program then
+    reports GP300 — new programs must be consciously accepted)."""
+    path = Path(path)
+    if not path.exists():
+        return {"platform": None, "programs": {}}
+    data = json.loads(path.read_text())
+    if data.get("version") != PROGRAMS_VERSION:
+        raise ValueError(
+            f"programs baseline {path} has version "
+            f"{data.get('version')!r}, this tool reads version "
+            f"{PROGRAMS_VERSION}")
+    return {"platform": data.get("platform"),
+            "programs": dict(data.get("programs", {}))}
+
+
+def save_programs(path: Path, reports, platform: str,
+                  old: dict | None = None) -> None:
+    """Write the measured reports as the new program baseline. Same
+    contract as ``save_baseline``: justifications and hand-tuned
+    tolerances survive for entries that persist, new entries get a TODO
+    marker and the default tolerances so review can't silently skip
+    them. Skipped programs keep their previous entry untouched (a
+    1-device host must not erase the dp budgets)."""
+    from .graftprog import DEFAULT_TOLERANCE
+    old_programs = (old or {}).get("programs", {})
+    programs = {}
+    for rep in sorted(reports, key=lambda r: r.name):
+        prev = old_programs.get(rep.name, {})
+        if rep.skipped is not None:
+            if prev:
+                programs[rep.name] = prev
+            continue
+        rules = {}
+        for rule in sorted(rep.rule_details):
+            n = rep.rule_count(rule)
+            if n:
+                rules[rule] = {
+                    "count": n,
+                    "justification": prev.get("rules", {}).get(rule, {})
+                    .get("justification") or "TODO: justify or fix",
+                }
+        entry = {
+            "fingerprint": rep.fingerprint,
+            "level": rep.level,
+            "flops": rep.flops,
+            "bytes_accessed": rep.bytes_accessed,
+            "tolerance": prev.get("tolerance", dict(DEFAULT_TOLERANCE)),
+            "justification": prev.get("justification")
+            or "TODO: justify or fix",
+        }
+        if rep.peak_bytes is not None:
+            entry["peak_bytes"] = rep.peak_bytes
+        if rules:
+            entry["rules"] = rules
+        programs[rep.name] = entry
+    # entries for programs that no longer register at all are dropped
+    # (the CLI's stale warning announced them); skipped ones survive
+    payload = {"version": PROGRAMS_VERSION, "platform": platform,
+               "programs": programs}
+    Path(path).write_text(json.dumps(payload, indent=2) + "\n")

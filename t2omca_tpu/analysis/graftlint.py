@@ -36,6 +36,12 @@ GL107     One allocation passed to two or more fields of a single
           donating a state whose leaves alias one buffer trips XLA's
           "donate the same buffer twice" check at dispatch).
 GL108     Module-level import never referenced (dead import).
+GL109     Array built OUTSIDE a traced function (module level, or in a
+          non-traced builder) and referenced inside one via closure:
+          the tracer bakes it into the program as a constant (GP202's
+          AST-side companion) — duplicated per executable, silently
+          stale if the binding is later updated. Pass it as an
+          argument instead.
 ========  ==============================================================
 
 Scope and honesty about limits: "traced code" means functions that are
@@ -73,6 +79,7 @@ RULES: Dict[str, str] = {
     "GL106": "time.* / datetime.* nondeterminism inside traced code",
     "GL107": "one allocation aliased across fields of one constructor",
     "GL108": "dead import (module-level import never referenced)",
+    "GL109": "closure-captured array constant in traced code (bake hazard)",
 }
 
 #: modules whose host syncs are throughput hazards (GL105). Matched with
@@ -107,6 +114,12 @@ _ALLOC_NAMES = frozenset(
     f"{ns}.{fn}" for ns in ("jax.numpy", "numpy")
     for fn in ("zeros", "ones", "full", "empty", "zeros_like", "ones_like",
                "full_like", "empty_like", "arange", "eye"))
+
+#: jnp/np-namespace calls that return static metadata, not arrays —
+#: capturing one by closure bakes nothing (GL109 exemption)
+_NONARRAY_CALLS = frozenset({
+    "dtype", "shape", "ndim", "size", "result_type", "promote_types",
+    "issubdtype", "iinfo", "finfo", "can_cast", "isscalar"})
 
 _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*disable(?:=(?P<rules>\S+))?")
@@ -430,6 +443,144 @@ class _ModuleLinter:
                       f"`.{call.func.attr}()` on a traced value forces a "
                       f"host sync in traced code")
 
+    # -------------------------------------------- closure-captured consts
+
+    def _is_array_expr(self, expr: ast.AST) -> bool:
+        """Expression that visibly builds an array: any call under the
+        jax.numpy/jax.lax/numpy namespaces in it — excluding the
+        helpers that return static metadata (dtypes, shapes, finfo),
+        which are legal and common closure captures."""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                c = self.canonical(n.func)
+                if c and (c.startswith(_ARRAY_PREFIXES)
+                          or c.startswith("numpy.")) \
+                        and c.rsplit(".", 1)[-1] not in _NONARRAY_CALLS:
+                    return True
+        return False
+
+    def _collect_scopes(self) -> None:
+        """Lexical scope tables for GL109 (computed once, on demand):
+        per scope (FunctionDef id, or None for module) the set of bound
+        names, the subset visibly bound to an array expression (with
+        the binding node), and each function's enclosing-scope chain."""
+        self._scope_bound: Dict[Optional[int], Set[str]] = {None: set()}
+        self._scope_arrays: Dict[Optional[int], Dict[str, ast.AST]] = \
+            {None: {}}
+        self._scope_chain: Dict[int, Tuple[Optional[int], ...]] = {}
+        class_ids: Set[int] = set()
+
+        def bind(scope: Optional[int], name: str) -> None:
+            self._scope_bound.setdefault(scope, set()).add(name)
+
+        def walk(node: ast.AST, scope: Optional[int],
+                 chain: Tuple[Optional[int], ...]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    bind(scope, child.name)
+                    fid = id(child)
+                    # closure-visible chain: the current scope joins it
+                    # only when it is a real closure scope — a class
+                    # body is not one (methods cannot capture class
+                    # attributes as free variables)
+                    vis = chain if scope in class_ids \
+                        else (scope,) + chain
+                    self._scope_chain[fid] = vis
+                    a = child.args
+                    for p in (a.posonlyargs + a.args + a.kwonlyargs):
+                        bind(fid, p.arg)
+                    for extra in (a.vararg, a.kwarg):
+                        if extra is not None:
+                            bind(fid, extra.arg)
+                    walk(child, fid, vis)
+                    continue
+                if isinstance(child, ast.ClassDef):
+                    bind(scope, child.name)
+                    # class-body bindings go to a sentinel scope that no
+                    # chain ever includes: `class C: TABLE = jnp.…` is an
+                    # attribute (C.TABLE), never a closure capture — it
+                    # must neither flag GL109 nor shadow a genuine
+                    # module-level binding of the same name
+                    class_ids.add(id(child))
+                    walk(child, id(child), chain)
+                    continue
+                if isinstance(child, (ast.Assign, ast.AnnAssign)):
+                    targets = (child.targets
+                               if isinstance(child, ast.Assign)
+                               else [child.target])
+                    arrayish = (child.value is not None
+                                and self._is_array_expr(child.value))
+                    for t in targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                bind(scope, n.id)
+                                if arrayish:
+                                    self._scope_arrays.setdefault(
+                                        scope, {})[n.id] = child
+                elif isinstance(child, ast.Name) and \
+                        isinstance(child.ctx, (ast.Store, ast.Del)):
+                    bind(scope, child.id)
+                walk(child, scope, chain)
+
+        walk(self.tree, None, ())
+
+    def _check_closure_consts(self, fn: ast.FunctionDef,
+                              traced_ids: Set[int]) -> None:
+        """GL109: a name FREE in this traced function whose closure
+        capture resolves — through the lexical scope chain — to an
+        array built at module scope or in a non-traced builder: it is
+        concrete at trace time and gets baked into the compiled program
+        as a constant (the weights-captured-by-closure class; GP202
+        audits the same hazard on the compiled side). A capture whose
+        nearest binder is a function parameter or a traced region is a
+        tracer, not a bakeable constant — never flagged. One finding
+        per name, at the first reference."""
+        local: Set[str] = set(self._scope_bound.get(id(fn), set()))
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) and node is not fn:
+                local.add(node.name)
+                if not isinstance(node, ast.ClassDef):
+                    # nested-def params shadow outer bindings for every
+                    # reference in that def's body — a module-level array
+                    # name reused as a scan-body parameter is a tracer
+                    # there, not a capture (coarse union: suppressing is
+                    # the conservative direction)
+                    a = node.args
+                    for p in (a.posonlyargs + a.args + a.kwonlyargs):
+                        local.add(p.arg)
+                    for extra in (a.vararg, a.kwarg):
+                        if extra is not None:
+                            local.add(extra.arg)
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                local.add(node.id)
+        flagged: Set[str] = set()
+        # nested defs are walked here too (they are traced by
+        # containment); independently-marked ones get their own pass,
+        # and the findings set dedupes the overlap
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            name = node.id
+            if name in local or name in flagged:
+                continue
+            for scope in self._scope_chain.get(id(fn), (None,)):
+                if name not in self._scope_bound.get(scope, set()):
+                    continue
+                src = self._scope_arrays.get(scope, {}).get(name)
+                if src is not None and scope not in traced_ids:
+                    flagged.add(name)
+                    self.emit(node, "GL109",
+                              f"`{name}` is an array built outside this "
+                              f"traced function (line {src.lineno}) and "
+                              f"captured by closure — trace bakes it in "
+                              f"as a program constant; pass it as an "
+                              f"argument")
+                break                    # nearest binder wins either way
+
     # ------------------------------------------------- module-scope rules
 
     def _check_hot_path(self) -> None:
@@ -522,8 +673,16 @@ class _ModuleLinter:
     def run(self) -> List[Finding]:
         if any(_SKIP_FILE_RE.search(l) for l in self.lines[:10]):
             return []
-        for fn, statics in self.traced_functions():
+        marked = self.traced_functions()
+        traced_ids: Set[int] = set()
+        for fn, _ in marked:
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    traced_ids.add(id(sub))
+        self._collect_scopes()
+        for fn, statics in marked:
             self._check_traced_function(fn, set(), statics)
+            self._check_closure_consts(fn, traced_ids)
         self._check_hot_path()
         self._check_donation_alias()
         self._check_dead_imports()

@@ -1,0 +1,184 @@
+"""Program registry: one place where the hot compiled programs get names.
+
+The driver builds its XLA programs inline (``run.run_sequential`` calls
+``Experiment.jitted_programs`` / ``superstep_program`` and throws the
+handles into the loop), so before this module nothing in the repo could
+*enumerate* them — the auditor (``graftprog``), the budget baseline
+(``analysis/programs.json``) and the compile-count tests each need a
+stable name → buildable-program mapping. The registry provides it:
+``run.py``, ``parallel/mesh.py`` and ``learners/qmix_learner.py`` each
+expose a ``register_audit_programs(reg)`` hook that names its programs
+once, and ``collect_default_programs()`` gathers them on demand.
+
+Programs are built against ``audit_config()`` — a frozen tiny CPU
+config (bf16 compute so the dtype-churn rule GP203 has teeth) — and are
+**lowered from abstract avals only** (``jax.eval_shape`` state +
+``ShapeDtypeStruct`` keys): the audit never runs an env step or a train
+step, so it fits the tier-1 gate without a TPU and without paying real
+rollout compute. Only entries marked ``compile=True`` pay an XLA
+compile (for ``memory_analysis`` and optimized-HLO costs); the rest are
+audited at the lowered (stable-HLO) level.
+
+The example arguments deliberately mimic the DRIVER's avals — e.g.
+``t_env`` is the weak-typed ``jnp.asarray(int)`` scalar the loop
+passes — so the recorded fingerprint is the fingerprint of the program
+the driver actually dispatches, and an aval drift between driver and
+registry (say a weak-type fix on one side only) shows up as GP304.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional, Tuple
+
+
+class SkipProgram(RuntimeError):
+    """Raised by a builder whose program cannot be built in this
+    environment (e.g. the data-parallel program on a 1-device host);
+    the auditor reports the skip and moves on — a skip is never a
+    finding, matching the lint ratchet's stale-entry semantics.
+    Hooks that detect the condition up front can instead register
+    ``AuditProgram.skipped(reason)``."""
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditProgram:
+    """One buildable named program.
+
+    ``fn`` is the *jitted* callable (so ``fn.trace``/``fn.lower`` serve
+    the auditor); ``args``/``kwargs`` are example arguments — abstract
+    ``ShapeDtypeStruct``/``eval_shape`` trees wherever possible.
+    ``donate_argnums`` mirrors what the driver donates (the auditor
+    checks every donated leaf is actually aliased — GP201).
+    ``compile=True`` opts into the XLA compile for ``memory_analysis``
+    + optimized-HLO costs (expensive: reserve it for the donated hot
+    programs)."""
+
+    fn: object
+    args: Tuple = ()
+    kwargs: Dict = dataclasses.field(default_factory=dict)
+    donate_argnums: Tuple[int, ...] = ()
+    compile: bool = False
+    description: str = ""
+    #: set when the program cannot be built in this environment; the
+    #: auditor records the reason instead of tracing
+    skip: Optional[str] = None
+
+    @classmethod
+    def skipped(cls, reason: str) -> "AuditProgram":
+        return cls(fn=None, skip=reason)
+
+
+@dataclasses.dataclass
+class AuditContext:
+    """Shared build products every hook draws from: the tiny-config
+    ``Experiment`` plus the ``eval_shape`` of its initial TrainState
+    (abstract — building it allocates nothing)."""
+
+    cfg: object
+    exp: object
+    ts_shape: object
+    superstep_k: int
+
+    @property
+    def compute_dtype(self) -> str:
+        return self.cfg.model.dtype
+
+
+#: the registry: insertion-ordered name -> AuditProgram
+Registry = Dict[str, AuditProgram]
+
+_ctx_lock = threading.Lock()
+_ctx: Optional[AuditContext] = None
+
+#: the superstep depth every audit builds with — small (cheap compile)
+#: but > 1 so the scan/gate structure is the real fused program's
+AUDIT_SUPERSTEP_K = 2
+
+
+def audit_config():
+    """The frozen tiny CPU config all default programs are built on.
+
+    bf16 compute + f32 replay storage: the mixed-precision path is the
+    one where a stray ``convert_element_type`` (GP203) or a baked f32
+    constant (GP202) silently doubles bytes, so that is the path the
+    canary watches. Shapes are test-scale — program *structure* (scan
+    bodies, donation aliasing, dtype churn, callbacks) is shape-
+    independent, and that structure is what the jaxpr rules audit;
+    the cost ratchets are relative to this config's own baseline."""
+    from ..config import (EnvConfig, ModelConfig, ReplayConfig, TrainConfig,
+                          sanity_check)
+    return sanity_check(TrainConfig(
+        batch_size_run=2, batch_size=4, superstep=AUDIT_SUPERSTEP_K,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=6, fast_norm=False),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1, dtype="bfloat16"),
+        replay=ReplayConfig(buffer_size=8),
+    ))
+
+
+def audit_context(rebuild: bool = False) -> AuditContext:
+    """Build (once per process) the shared audit context. Cached: the
+    ``Experiment`` build pins the process-global PRNG impl and costs
+    ~1 s, and every hook needs the same one for fingerprint stability."""
+    global _ctx
+    with _ctx_lock:
+        if _ctx is None or rebuild:
+            import jax
+
+            from ..run import Experiment
+            cfg = audit_config()
+            exp = Experiment.build(cfg)
+            ts_shape = jax.eval_shape(lambda: exp.init_train_state(cfg.seed))
+            _ctx = AuditContext(cfg=cfg, exp=exp, ts_shape=ts_shape,
+                                superstep_k=AUDIT_SUPERSTEP_K)
+        return _ctx
+
+
+def collect_default_programs() -> Registry:
+    """Gather every registered program from the component hooks, in a
+    stable order (run.py's driver programs, then the data-parallel and
+    learner surfaces). Each module names its own programs — the
+    registry stays free of program-construction knowledge."""
+    from .. import run as run_mod
+    from ..learners import qmix_learner as learner_mod
+    from ..parallel import mesh as mesh_mod
+
+    reg: Registry = {}
+    ctx = audit_context()
+    for mod in (run_mod, mesh_mod, learner_mod):
+        hook = getattr(mod, "register_audit_programs", None)
+        if hook is None:
+            continue
+        for name, prog in hook(ctx).items():
+            if name in reg:
+                raise ValueError(
+                    f"audit program {name!r} registered twice "
+                    f"({mod.__name__} collides with an earlier hook)")
+            reg[name] = prog
+    return reg
+
+
+def load_programs_from(path_or_module: str) -> Registry:
+    """Load extra programs from a module path or a ``.py`` file that
+    defines ``register_audit_programs(ctx) -> dict`` — the seeded-
+    regression entry point for the CLI tests (``--program-module``)."""
+    import importlib
+    import importlib.util
+
+    if path_or_module.endswith(".py"):
+        spec = importlib.util.spec_from_file_location(
+            "_graftprog_extra", path_or_module)
+        if spec is None or spec.loader is None:
+            raise ValueError(f"cannot import {path_or_module!r}")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    else:
+        mod = importlib.import_module(path_or_module)
+    hook = getattr(mod, "register_audit_programs", None)
+    if hook is None:
+        raise ValueError(
+            f"{path_or_module!r} defines no register_audit_programs")
+    return dict(hook(audit_context()))

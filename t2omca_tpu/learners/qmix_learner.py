@@ -496,3 +496,26 @@ class QMixLearner:
 
 
 LEARNER_REGISTRY = {"qmix_learner": QMixLearner}
+
+
+def register_audit_programs(ctx):
+    """graftprog registry hook (``analysis/registry.py``): the bare
+    learner update as its own named program — the narrowest surface the
+    dtype-churn rule (GP203) watches, so an upcast introduced in the
+    loss/optimizer math is attributed to the learner even before it
+    shows up in the fused superstep's budgets. Audited from abstract
+    avals only (the replay sample's eval_shape); never executed."""
+    import jax
+
+    from ..analysis.registry import AuditProgram
+    exp, ts, cfg = ctx.exp, ctx.ts_shape, ctx.cfg
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    batch, _, weights = jax.eval_shape(
+        lambda b, k, t: exp.buffer.sample(b, k, cfg.batch_size, t),
+        ts.buffer, key, ts.runner.t_env)
+    train = jax.jit(exp.learner.train)
+    return {"learner_train": AuditProgram(
+        train, (ts.learner, batch, weights, ts.runner.t_env, ts.episode,
+                key),
+        description="one importance-weighted QMIX update (loss + "
+                    "optimizer + target sync)")}

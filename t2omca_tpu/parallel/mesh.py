@@ -155,6 +155,18 @@ class DataParallel:
         return self.exp.superstep_program(k, donate=donate,
                                           **self._constraint_hooks())
 
+    def audit_avals(self, ts_like):
+        """The TrainState avals the DRIVER hands this wrapper's
+        programs: each eval_shape leaf annotated with its canonical
+        ``state_shardings`` placement, so the auditor lowers the same
+        SPMD program ``run_sequential`` dispatches (unsharded avals
+        would lower a different — single-device — executable and the
+        recorded fingerprint/budgets would be fiction)."""
+        return jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                               sharding=sh),
+            ts_like, self.state_shardings(ts_like))
+
     def _constraint_hooks(self):
         """The shared ``constrain_*`` kwargs: one source for the canonical
         placement of every value the driver loop (or the superstep scan)
@@ -186,3 +198,34 @@ class DataParallel:
             constrain_buffer=constrain_buffer,
             constrain_learner=lambda l: jax.tree.map(
                 lambda x: wsc(x, rep), l))
+
+
+#: data-axis width the audit builds with — the smallest real mesh, so
+#: the SPMD program structure (partitioned scatter/psum) is audited
+#: without depending on how many devices the auditing host happens to
+#: expose beyond two
+AUDIT_MESH_DEVICES = 2
+
+
+def register_audit_programs(ctx):
+    """graftprog registry hook: the data-parallel superstep under a
+    fixed ``AUDIT_MESH_DEVICES``-wide mesh (fingerprints must not vary
+    with the host's device count). Skipped — never failed — on hosts
+    exposing fewer CPU devices."""
+    from ..analysis.registry import AuditProgram
+    import jax.numpy as jnp
+    if len(jax.devices()) < AUDIT_MESH_DEVICES:
+        return {"dp_superstep": AuditProgram.skipped(
+            f"needs >= {AUDIT_MESH_DEVICES} devices (hint: XLA_FLAGS="
+            f"--xla_force_host_platform_device_count="
+            f"{AUDIT_MESH_DEVICES})")}
+    dp = DataParallel(ctx.exp, make_mesh(AUDIT_MESH_DEVICES))
+    k = ctx.superstep_k
+    sup = dp.superstep_program(k, donate=True)
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    keys = jax.ShapeDtypeStruct((k,) + key.shape, key.dtype)
+    return {"dp_superstep": AuditProgram(
+        sup, (dp.audit_avals(ctx.ts_shape), keys, jnp.asarray(0)),
+        donate_argnums=(0,),
+        description=f"fused K={k} superstep sharded over a "
+                    f"{AUDIT_MESH_DEVICES}-device data axis")}

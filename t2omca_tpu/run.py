@@ -368,6 +368,46 @@ class Experiment:
         return jax.jit(_superstep, donate_argnums=(0,) if donate else ())
 
 
+def register_audit_programs(ctx):
+    """graftprog registry hook (``analysis/registry.py``): name the
+    driver's hot programs once, so the compiled-program auditor and the
+    budget baseline (``analysis/programs.json``) can build exactly what
+    ``run_sequential`` dispatches. Everything is abstract — eval_shape
+    state + ShapeDtypeStruct keys — and ``t_env`` is the driver's own
+    weak-typed ``jnp.asarray(int)`` scalar, so the recorded fingerprint
+    is the fingerprint of the program the loop actually runs (an aval
+    drift between driver and audit surfaces as GP304)."""
+    from .analysis.registry import AuditProgram
+    exp, ts, k = ctx.exp, ctx.ts_shape, ctx.superstep_k
+    rollout, insert, train_iter = exp.jitted_programs(donate=True)
+    sup = exp.superstep_program(k, donate=True)
+    params, rs = ts.learner.params["agent"], ts.runner
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    keys = jax.ShapeDtypeStruct((k,) + key.shape, key.dtype)
+    t_env = jnp.asarray(0)           # weak-typed, like the driver's
+    _, batch, _ = jax.eval_shape(
+        lambda p, r: rollout(p, r, test_mode=False), params, rs)
+    return {
+        "rollout": AuditProgram(
+            rollout, (params, rs), kwargs=dict(test_mode=False),
+            description="parallel env rollout (classic path + test "
+                        "cadence)"),
+        "insert": AuditProgram(
+            insert, (ts.buffer, batch), donate_argnums=(0,),
+            description="episode-batch ring insert (classic path, "
+                        "donated ring)"),
+        "train_iter": AuditProgram(
+            train_iter, (ts, key, t_env), donate_argnums=(0,),
+            compile=True,
+            description="sample -> train -> priority feedback "
+                        "(donated TrainState)"),
+        "superstep": AuditProgram(
+            sup, (ts, keys, t_env), donate_argnums=(0,), compile=True,
+            description=f"fused K={k} rollout->insert->train superstep "
+                        f"(donated TrainState)"),
+    }
+
+
 def run(cfg: TrainConfig, logger: Optional[Logger] = None) -> TrainState:
     """Top-level entry (reference ``run``, ``per_run.py:20-66``): set up the
     unique token and sinks, then train (or evaluate and exit)."""

@@ -1,0 +1,60 @@
+"""Seeded-regression programs for the graftprog CLI tests.
+
+Each program plants exactly one hazard class the auditor must catch
+(ISSUE acceptance: every seeded regression flips ``python -m
+t2omca_tpu.analysis --programs`` to exit 1 with the matching GP rule).
+Loaded via ``--program-module tests/fixtures_graftprog.py``; everything
+is abstract avals — nothing here ever executes.
+"""
+
+
+def register_audit_programs(ctx):
+    import jax
+    import jax.numpy as jnp
+
+    from t2omca_tpu.analysis.registry import AuditProgram
+
+    del ctx
+    f32 = jnp.float32
+
+    # GP201: `y` is marked donated but never flows to an output — XLA
+    # cannot alias it, the buffer is silently copied (2x memory class)
+    def _undonated(x, y):
+        return x + 1.0 + 0.0 * jnp.sum(y) * 0.0
+
+    # GP202: a (256, 256) f32 "weight" captured by closure — baked into
+    # the program as a 256 KiB constant
+    big = jnp.ones((256, 256), f32)
+
+    def _baked(x):
+        return x @ big
+
+    # GP203: bf16 input upcast to f32 mid-program (the audit config's
+    # compute dtype is bfloat16)
+    def _upcast(x):
+        return jnp.sum(x.astype(f32))
+
+    # GP204: a host callback inside the program
+    def _callback(x):
+        return jax.pure_callback(
+            lambda v: v, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    # clean control: none of the rules fire
+    def _clean(x):
+        return x * 2.0
+
+    vec = jax.ShapeDtypeStruct((8, 8), f32)
+    return {
+        "seeded_gp201": AuditProgram(
+            jax.jit(_undonated, donate_argnums=(0, 1)),
+            (vec, jax.ShapeDtypeStruct((3,), f32)),
+            donate_argnums=(0, 1)),
+        "seeded_gp202": AuditProgram(
+            jax.jit(_baked), (jax.ShapeDtypeStruct((8, 256), f32),)),
+        "seeded_gp203": AuditProgram(
+            jax.jit(_upcast), (jax.ShapeDtypeStruct((16,), jnp.bfloat16),)),
+        "seeded_gp204": AuditProgram(jax.jit(_callback), (vec,)),
+        "seeded_clean": AuditProgram(
+            jax.jit(_clean, donate_argnums=(0,)), (vec,),
+            donate_argnums=(0,)),
+    }

@@ -265,6 +265,115 @@ def test_gl108_negatives_init_all_and_annotations():
     ) == []
 
 
+# --------------------------------------------------------------- GL109
+
+def test_gl109_module_level_capture():
+    src = """
+import jax, jax.numpy as jnp
+TABLE = jnp.arange(1000)
+@jax.jit
+def f(x):
+    return x + TABLE
+"""
+    fs = lint_source(src, "fixture.py")
+    assert [f.rule for f in fs] == ["GL109"]
+    assert "`TABLE`" in fs[0].message and "line 3" in fs[0].message
+
+
+def test_gl109_nontraced_builder_capture():
+    src = """
+import jax, jax.numpy as jnp
+def make():
+    table = jnp.ones((256, 256))
+    @jax.jit
+    def f(x):
+        return x @ table
+    return f
+"""
+    assert rules_of(src) == ["GL109"]
+
+
+def test_gl109_negatives_param_shadow_and_traced_source():
+    src = """
+import jax, jax.numpy as jnp
+
+def init():                      # unrelated scope, same name
+    weights = jnp.zeros((8, 8))
+    return weights
+
+def apply(weights, x):           # the capture resolves to THIS param
+    def body(c, t):
+        return c @ weights + t, None
+    return jax.lax.scan(body, x, None, length=3)
+
+@jax.jit
+def g(x):
+    y = jnp.abs(x)               # bound locally: a tracer, not a const
+    def inner(z):
+        return z + y
+    return inner(x)
+"""
+    assert rules_of(src) == []
+
+
+def test_gl109_negative_nested_param_shadows_module_array():
+    src = """
+import jax, jax.numpy as jnp
+W = jnp.ones((256, 256))
+@jax.jit
+def f(x, ws):
+    def body(carry, W):              # param shadows the module array
+        return carry @ W, None
+    return jax.lax.scan(body, x, ws)
+"""
+    assert rules_of(src) == []
+
+
+def test_gl109_negative_class_attribute_is_not_a_closure_binding():
+    src = """
+import jax, jax.numpy as jnp
+class Cfg:
+    TABLE = jnp.arange(1000)     # attribute (Cfg.TABLE), not a capture
+@jax.jit
+def f(x):
+    return x + Cfg.TABLE.shape[0]
+"""
+    assert rules_of(src) == []
+    # ...and a class attr must not shadow a REAL module-level array
+    src2 = """
+import jax, jax.numpy as jnp
+class C:
+    TABLE = jnp.zeros(())
+TABLE = jnp.arange(1000)
+@jax.jit
+def f(x):
+    return x + TABLE
+"""
+    assert rules_of(src2) == ["GL109"]
+
+
+def test_gl109_negative_static_metadata_capture():
+    src = """
+import jax, jax.numpy as jnp
+sd = jnp.dtype("bfloat16")       # static metadata, not an array
+@jax.jit
+def f(x):
+    return x.astype(sd)
+"""
+    assert rules_of(src) == []
+
+
+def test_gl109_suppression():
+    src = """
+import jax, jax.numpy as jnp
+TABLE = jnp.arange(10)
+@jax.jit
+def f(x):
+    return x + TABLE  # graftlint: disable=GL109
+"""
+    assert rules_of(src) == []
+
+
 # ---------------------------------------------------------- suppression
 
 def test_inline_suppression_and_skip_file():
@@ -414,7 +523,8 @@ def test_rule_catalog_documented():
     """Every rule ID is in docs/ANALYSIS.md and vice versa (the catalog
     is the user-facing contract)."""
     doc = (REPO / "docs" / "ANALYSIS.md").read_text()
-    for rule in RULES:
+    from t2omca_tpu.analysis.graftprog import GP_RULES
+    for rule in list(RULES) + list(GP_RULES):
         assert rule in doc, f"{rule} missing from docs/ANALYSIS.md"
 
 
