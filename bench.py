@@ -27,6 +27,17 @@ import time
 
 import numpy as np
 
+from t2omca_tpu.obs.spans import SpanRecorder
+
+#: graftscope span recorder for the bench phases (stdlib-only import —
+#: must not trigger jax before the smoke path pins JAX_PLATFORMS). The
+#: emitted record embeds ``_REC.summary()`` so every BENCH_r*.json
+#: carries the per-phase breakdown (probe / build / compile / warm /
+#: measure), and on failure ``main_flight`` emits a partial record with
+#: the open phase + flight tail — a wedged TPU bench is then
+#: diagnosable instead of a bare "backend init" death (BENCH_r03–r05).
+_REC = SpanRecorder(ring_size=128)
+
 
 def probe_backend(probe_s: float, _cmd=None) -> "dict | None":
     """Bounded backend-init probe in a SUBPROCESS, one retry. A wedged
@@ -241,16 +252,18 @@ def _train_numbers(cfg, _time, train_bs: int | None = None,
         batch_size=bs,
         replay=dataclasses.replace(cfg.replay, prioritized=True,
                                    buffer_size=2 * cfg.batch_size_run))
-    exp = Experiment.build(cfg)
-    ts = exp.init_train_state(0)
+    with _REC.span("bench.build", leg="train"):
+        exp = Experiment.build(cfg)
+        ts = exp.init_train_state(0)
     rollout, insert, train_iter = exp.jitted_programs()
     b, t_len = cfg.batch_size_run, cfg.env_args.episode_limit
 
     # fill the buffer with one rollout so PER has real priorities
-    rs, batch, _ = rollout(ts.learner.params["agent"], ts.runner,
-                           test_mode=False)
-    ts = ts.replace(runner=rs, buffer=insert(ts.buffer, batch),
-                    episode=jnp.asarray(b, jnp.int32))
+    with _REC.span("bench.compile", leg="train"):
+        rs, batch, _ = rollout(ts.learner.params["agent"], ts.runner,
+                               test_mode=False)
+        ts = ts.replace(runner=rs, buffer=insert(ts.buffer, batch),
+                        episode=jnp.asarray(b, jnp.int32))
     key = jax.random.PRNGKey(7)
 
     def train_step(ts_):
@@ -263,8 +276,9 @@ def _train_numbers(cfg, _time, train_bs: int | None = None,
         ts2 = ts_.replace(runner=rs2, buffer=insert(ts_.buffer, batch2))
         return train_step(ts2)
 
-    dt_train = _time(lambda: train_step(ts)[1])
-    dt_full = _time(lambda: interleaved_step(ts)[1])
+    with _REC.span("bench.measure", leg="train"):
+        dt_train = _time(lambda: train_step(ts)[1])
+        dt_full = _time(lambda: interleaved_step(ts)[1])
 
     env_steps = b * t_len
     print(f"# train_iter ({bs} episodes x {t_len + 1} slots, PER on): "
@@ -326,14 +340,16 @@ def bench_dp(cfg, _time, args) -> int:
         batch_size_run=envs, batch_size=bs,
         replay=dataclasses.replace(cfg.replay, buffer_size=ring,
                                    prioritized=True))
-    exp = Experiment.build(cfg)
-    mesh = make_mesh(n_dev)
-    dp = DataParallel(exp, mesh)
-    ts = dp.shard(exp.init_train_state(0))
+    with _REC.span("bench.build", leg="dp"):
+        exp = Experiment.build(cfg)
+        mesh = make_mesh(n_dev)
+        dp = DataParallel(exp, mesh)
+        ts = dp.shard(exp.init_train_state(0))
     rollout, insert, train_iter = dp.jitted_programs()
     params = ts.learner.params["agent"]
 
-    rs, batch, _ = rollout(params, ts.runner, test_mode=False)
+    with _REC.span("bench.compile", leg="dp"):
+        rs, batch, _ = rollout(params, ts.runner, test_mode=False)
     obs_leaf = jax.tree.leaves(batch.obs)[0]
     assert len(obs_leaf.sharding.device_set) == n_dev
 
@@ -341,7 +357,8 @@ def bench_dp(cfg, _time, args) -> int:
         _, b, _ = rollout(params, ts.runner, test_mode=False)
         return b.reward[0, 0]
 
-    dt = _time(one)
+    with _REC.span("bench.measure", leg="dp"):
+        dt = _time(one)
     env_steps = cfg.batch_size_run * cfg.env_args.episode_limit
     rate = env_steps / dt
     print(f"# DP={n_dev} rollout: {dt * 1e3:.1f} ms for {env_steps} "
@@ -422,6 +439,7 @@ def bench_dp(cfg, _time, args) -> int:
     else:
         rec = rollout_rec
     rec.update(pipe_keys)
+    rec["spans"] = _REC.summary()
     print(json.dumps(rec))
     return 0
 
@@ -450,19 +468,22 @@ def bench_superstep(cfg, _time, args) -> int:
         replay=dataclasses.replace(
             cfg.replay, prioritized=True,
             buffer_size=max(cfg.replay.buffer_size, 2 * b, bs)))
-    exp = Experiment.build(cfg)
-    ts = exp.init_train_state(0)
-    # un-donated: the timed dispatches re-run on the same warmed state
-    superstep = exp.superstep_program(k)
+    with _REC.span("bench.build"):
+        exp = Experiment.build(cfg)
+        ts = exp.init_train_state(0)
+        # un-donated: the timed dispatches re-run on the same warmed state
+        superstep = exp.superstep_program(k)
     keys = jax.random.split(jax.random.PRNGKey(7), k)
     t_len = cfg.env_args.episode_limit
     # warm dispatch (compile + ring fill: k·b episodes) so the timed
     # dispatches exercise the train branch of the gate
-    ts, _, _ = superstep(ts, keys, jnp.zeros((), jnp.int32))
-    gate_open = int(jax.device_get(ts.buffer.episodes_in_buffer)) >= bs
+    with _REC.span("bench.compile", k=k):
+        ts, _, _ = superstep(ts, keys, jnp.zeros((), jnp.int32))
+        gate_open = int(jax.device_get(ts.buffer.episodes_in_buffer)) >= bs
 
-    dt = _time(lambda: superstep(ts, keys,
-                                 jnp.asarray(1000, jnp.int32))[1].epsilon[-1])
+    with _REC.span("bench.measure", k=k):
+        dt = _time(lambda: superstep(
+            ts, keys, jnp.asarray(1000, jnp.int32))[1].epsilon[-1])
     env_steps = k * b * t_len
     rate = env_steps / dt
     print(f"# superstep K={k}: {dt * 1e3:.1f} ms/dispatch for {env_steps} "
@@ -481,6 +502,7 @@ def bench_superstep(cfg, _time, args) -> int:
         "train_batch_episodes": bs,
         "train_gate_open": gate_open,
         "dispatch_s": round(dt, 4),
+        "spans": _REC.summary(),
     }))
     return 0
 
@@ -496,6 +518,7 @@ def bench_train(cfg, _time, args) -> int:
         "vs_baseline": None,
     }
     rec.update(nums)
+    rec["spans"] = _REC.summary()
     print(json.dumps(rec))
     return 0
 
@@ -613,13 +636,15 @@ def bench_prod_hbm(cfg) -> int:
     from t2omca_tpu.run import Experiment
 
     n_dev = 8
-    exp = Experiment.build(cfg)
-    mesh = make_mesh(n_dev)
-    dp = DataParallel(exp, mesh)
-    # born-sharded init: shard(init_train_state(0)) holds TWO copies of
-    # the ring during the device_put (the measured OOM at ring=16384 on a
-    # 125 GiB host — and the same 2x transient a real slice would pay)
-    ts = dp.init_sharded(0)
+    with _REC.span("bench.build", leg="prod_hbm"):
+        exp = Experiment.build(cfg)
+        mesh = make_mesh(n_dev)
+        dp = DataParallel(exp, mesh)
+        # born-sharded init: shard(init_train_state(0)) holds TWO copies
+        # of the ring during the device_put (the measured OOM at
+        # ring=16384 on a 125 GiB host — and the same 2x transient a
+        # real slice would pay)
+        ts = dp.init_sharded(0)
     # production contract: ring donated to insert, state to train_iter
     rollout, insert, train_iter = dp.jitted_programs(donate=True)
 
@@ -698,6 +723,7 @@ def bench_prod_hbm(cfg) -> int:
         # analytic-only leg, stated as such:
         "rollout_batch_8192_analytic_gib": round(batch_analytic / gib, 3),
     }
+    rec["spans"] = _REC.summary()
     print(json.dumps(rec))
     return 0
 
@@ -714,20 +740,29 @@ def bench_all(make_cfg, _time, _pipe_rate, args) -> int:
     from t2omca_tpu.run import Experiment
 
     def emit(rec):
+        # cumulative per-phase summary (leg meta distinguishes the
+        # sub-benches in the span stream; the summary aggregates)
+        rec.setdefault("spans", _REC.summary())
         print(json.dumps(rec), flush=True)
 
     def rollout_rate(cfg, label, extra=None):
-        exp = Experiment.build(cfg)
-        ts = exp.init_train_state(0)
+        # each leg carries its own spans (leg=<label> meta); the
+        # records embed the CUMULATIVE summary, so a wedge in any leg
+        # still leaves the earlier legs' phase timings on record
+        with _REC.span("bench.build", leg=label):
+            exp = Experiment.build(cfg)
+            ts = exp.init_train_state(0)
         rollout = jax.jit(exp.runner.run, static_argnames="test_mode")
         params = ts.learner.params["agent"]
-        rs, batch, _ = rollout(params, ts.runner, test_mode=False)
+        with _REC.span("bench.compile", leg=label):
+            rs, batch, _ = rollout(params, ts.runner, test_mode=False)
 
         def one():
             _, b, _ = rollout(params, rs, test_mode=False)
             return b.reward[0, 0]
 
-        dt = _time(one)
+        with _REC.span("bench.measure", leg=label):
+            dt = _time(one)
         env_steps = cfg.batch_size_run * cfg.env_args.episode_limit
         rec = {
             "metric": "env_steps_per_sec",
@@ -948,11 +983,13 @@ def main() -> int:
                         else ("env_steps_per_sec", "env-steps/s/chip"))
         probe_s = float(os.environ.get("T2OMCA_BACKEND_PROBE_TIMEOUT",
                                        "900"))
-        failure = probe_backend(probe_s)
+        with _REC.span("bench.probe"):
+            failure = probe_backend(probe_s)
         if failure is not None:
             print(json.dumps({
                 "metric": metric, "value": None,
                 "unit": unit, "vs_baseline": None, **failure,
+                "spans": _REC.summary(),
             }), flush=True)
             return 1
 
@@ -1107,19 +1144,22 @@ def main() -> int:
             ts = exp.init_train_state(0)
             return breakdown(cfg, exp, ts, _time, args)
 
-    exp = Experiment.build(cfg)
-    ts = exp.init_train_state(0)
+    with _REC.span("bench.build"):
+        exp = Experiment.build(cfg)
+        ts = exp.init_train_state(0)
     rollout = jax.jit(exp.runner.run, static_argnames="test_mode")
     params = ts.learner.params["agent"]
 
     # compile + warm-up (two runs: tunnel queues make the first timed run
     # unrepresentative)
     t0 = time.perf_counter()
-    rs, batch, stats = rollout(params, ts.runner, test_mode=False)
-    _sync(batch.reward[0, 0])
+    with _REC.span("bench.compile"):
+        rs, batch, stats = rollout(params, ts.runner, test_mode=False)
+        _sync(batch.reward[0, 0])
     compile_s = time.perf_counter() - t0
-    rs, batch, stats = rollout(params, rs, test_mode=False)
-    _sync(batch.reward[0, 0])
+    with _REC.span("bench.warm"):
+        rs, batch, stats = rollout(params, rs, test_mode=False)
+        _sync(batch.reward[0, 0])
     print(f"# compile+first-run: {compile_s:.1f}s  "
           f"devices={jax.devices()}", file=sys.stderr)
 
@@ -1127,8 +1167,9 @@ def main() -> int:
     with tracing():
         for _ in range(args.iters):
             t0 = time.perf_counter()
-            rs, batch, stats = rollout(params, rs, test_mode=False)
-            _sync(batch.reward[0, 0])
+            with _REC.span("bench.measure"):
+                rs, batch, stats = rollout(params, rs, test_mode=False)
+                _sync(batch.reward[0, 0])
             times.append(time.perf_counter() - t0)
     times.sort()
     dt = times[len(times) // 2]
@@ -1177,9 +1218,58 @@ def main() -> int:
         except Exception as e:      # pragma: no cover - defensive
             print(f"# train bench failed: {e!r}", file=sys.stderr)
 
+    # per-phase span summary (probe / build / compile / warm / measure
+    # + the train half's legs): first_ms isolates the compile,
+    # steady_ms the warm rate — the record says where the time went.
+    # Set LAST so the train-half spans above are included.
+    line["spans"] = _REC.summary()
     print(json.dumps(line))
     return 0
 
 
+def main_flight() -> int:
+    """``main()`` with a flight-recorder net: any unhandled failure
+    still leaves ONE parseable JSON line on stdout — the partial record
+    with the phase it died in (``bench.probe`` / ``bench.build`` /
+    ``bench.compile`` / ...) and the span tail, so the next wedged TPU
+    bench run produces a BENCH_r*.json that says WHERE it died instead
+    of a bare traceback on stderr. Argparse/SystemExit (usage errors)
+    pass through: those already print their own diagnostics and no
+    measurement was in flight."""
+    try:
+        return main()
+    except KeyboardInterrupt:
+        raise
+    except Exception as e:  # noqa: BLE001 — the record IS the handler
+        # the failing span has already closed (the exception unwound
+        # through its __exit__), so fall back from the open-span phase
+        # to the most recent span that recorded an error outcome
+        phase = _REC.current_phase()
+        if phase is None:
+            for ev in reversed(_REC.tail()):
+                if (ev.get("event") == "span"
+                        and str(ev.get("outcome", "")).startswith("error")):
+                    phase = ev["phase"]
+                    break
+        # match main()'s probe-failure record: a crashed --train run
+        # must not file its partial record under the rollout metric
+        metric, unit = (("train_steps_per_sec", "train-steps/s/chip")
+                        if "--train" in sys.argv
+                        else ("env_steps_per_sec", "env-steps/s/chip"))
+        print(f"# bench failed in phase {phase or 'unknown'}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        print(json.dumps({
+            "metric": metric, "value": None,
+            "unit": unit, "vs_baseline": None,
+            "error": f"{type(e).__name__}: {e}"[:500],
+            "phase": phase,
+            "spans": _REC.summary(),
+            "spans_tail": _REC.tail()[-20:],
+            # default=repr: a non-JSON span-meta value must degrade,
+            # not crash the crash handler and lose the record
+        }, default=repr), flush=True)
+        return 1
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main_flight())

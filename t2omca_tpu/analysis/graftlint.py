@@ -42,6 +42,12 @@ GL109     Array built OUTSIDE a traced function (module level, or in a
           AST-side companion) — duplicated per executable, silently
           stale if the binding is later updated. Pass it as an
           argument instead.
+GL110     A device-boundary wrapper call (``_watched`` / ``_sync_point``
+          / ``_dispatch``) whose literal phase is not registered in
+          ``obs/spans.KNOWN_PHASES``: the graftscope span/flight
+          coverage (and the GL110 check itself) is keyed on that set,
+          so an unregistered phase is a dispatch boundary whose hangs
+          and failures leave no telemetry trail — register it.
 ========  ==============================================================
 
 Scope and honesty about limits: "traced code" means functions that are
@@ -80,7 +86,16 @@ RULES: Dict[str, str] = {
     "GL107": "one allocation aliased across fields of one constructor",
     "GL108": "dead import (module-level import never referenced)",
     "GL109": "closure-captured array constant in traced code (bake hazard)",
+    "GL110": "device-boundary wrapper phase missing from obs span registry",
 }
+
+#: driver helper names whose first argument is a span/watchdog phase
+#: (run.py). GL110 checks literal phases at their call sites against
+#: the span registry parsed from SPAN_REGISTRY_PATH.
+SPAN_WRAPPERS = frozenset({"_watched", "_sync_point", "_dispatch"})
+#: where the span-phase registry lives (parsed by AST, never imported —
+#: the lint CLI stays jax-free and import-free)
+SPAN_REGISTRY_PATH = "t2omca_tpu/obs/spans.py"
 
 #: modules whose host syncs are throughput hazards (GL105). Matched with
 #: fnmatch against the repo-relative posix path.
@@ -163,13 +178,17 @@ class _ModuleLinter:
     """One parsed module: alias resolution, traced-region discovery, and
     the rule walks. Produces a deduplicated, line-sorted finding list."""
 
-    def __init__(self, src: str, path: str, hot: Optional[bool] = None):
+    def __init__(self, src: str, path: str, hot: Optional[bool] = None,
+                 span_phases: Optional[Set[str]] = None):
         self.src = src
         self.path = path
         self.lines = src.splitlines()
         self.tree = ast.parse(src, filename=path)
         self.hot = (any(fnmatch.fnmatch(path, g) for g in HOT_PATH_GLOBS)
                     if hot is None else hot)
+        #: registered span phases for GL110 (None = rule disabled: the
+        #: registry file was absent or the caller didn't supply one)
+        self.span_phases = span_phases
         #: local alias -> canonical module/function dotted path
         self.modmap: Dict[str, str] = {}
         #: function name -> [FunctionDef] (all scopes, by simple name)
@@ -635,6 +654,39 @@ class _ModuleLinter:
                                 f"distinct buffers (XLA donate-twice "
                                 f"check) — allocate per field")
 
+    def _check_span_phases(self) -> None:
+        """GL110: every literal phase handed to a device-boundary
+        wrapper (``_watched``/``_sync_point``/``_dispatch``) must be in
+        the span registry — the graftscope coverage contract. Only
+        plain-name calls with a literal first ``phase`` argument are
+        checkable; dynamic phases are invisible to AST and skipped
+        (none exist in the driver today, and introducing one dodges
+        this coverage check — don't)."""
+        if self.span_phases is None:
+            return
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in SPAN_WRAPPERS):
+                continue
+            phase = None
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                phase = node.args[0].value
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "phase" \
+                            and isinstance(kw.value, ast.Constant) \
+                            and isinstance(kw.value.value, str):
+                        phase = kw.value.value
+            if phase is not None and phase not in self.span_phases:
+                self.emit(node, "GL110",
+                          f"phase {phase!r} passed to "
+                          f"`{node.func.id}` is not registered in "
+                          f"obs/spans.KNOWN_PHASES — this dispatch "
+                          f"boundary has no span/flight coverage "
+                          f"contract; add it to the registry")
+
     def _check_dead_imports(self) -> None:
         if self.path.endswith("__init__.py"):
             return                     # re-export surface: imports ARE use
@@ -686,21 +738,52 @@ class _ModuleLinter:
         self._check_hot_path()
         self._check_donation_alias()
         self._check_dead_imports()
+        self._check_span_phases()
         return sorted(self.findings,
                       key=lambda f: (f.path, f.line, f.col, f.rule))
 
 
 # ---------------------------------------------------------------- frontend
 
+def collect_span_phases(root: Path) -> Optional[Set[str]]:
+    """Parse ``KNOWN_PHASES`` out of the span registry
+    (``obs/spans.py``) by AST — never imported, so the lint CLI stays
+    import-free. None (GL110 disabled) when the file or the assignment
+    is absent; a registry that exists but parses to zero phases is
+    still a live (maximally strict) rule."""
+    path = Path(root) / SPAN_REGISTRY_PATH
+    if not path.is_file():
+        return None
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "KNOWN_PHASES"
+                   for t in node.targets):
+            continue
+        return {n.value for n in ast.walk(node.value)
+                if isinstance(n, ast.Constant)
+                and isinstance(n.value, str)}
+    return None
+
+
 def lint_source(src: str, path: str = "<memory>",
-                hot: Optional[bool] = None) -> List[Finding]:
-    """Lint one source string (fixture entry point for the tests)."""
-    return _ModuleLinter(src, path, hot=hot).run()
+                hot: Optional[bool] = None,
+                span_phases: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint one source string (fixture entry point for the tests).
+    ``span_phases`` arms GL110 (``lint_package`` supplies the real
+    registry; fixtures pass their own set)."""
+    return _ModuleLinter(src, path, hot=hot,
+                         span_phases=span_phases).run()
 
 
-def lint_file(path: Path, root: Path) -> List[Finding]:
+def lint_file(path: Path, root: Path,
+              span_phases: Optional[Set[str]] = None) -> List[Finding]:
     rel = path.resolve().relative_to(root.resolve()).as_posix()
-    return lint_source(path.read_text(), rel)
+    return lint_source(path.read_text(), rel, span_phases=span_phases)
 
 
 def lint_package(root: Path,
@@ -710,11 +793,12 @@ def lint_package(root: Path,
     root = Path(root)
     if paths is None:
         paths = [root / "t2omca_tpu"]
+    span_phases = collect_span_phases(root)
     findings: List[Finding] = []
     for p in paths:
         p = Path(p)
         files: Iterable[Path] = (sorted(p.rglob("*.py")) if p.is_dir()
                                  else [p])
         for f in files:
-            findings.extend(lint_file(f, root))
+            findings.extend(lint_file(f, root, span_phases=span_phases))
     return findings

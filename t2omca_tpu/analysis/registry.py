@@ -96,6 +96,28 @@ _ctx: Optional[AuditContext] = None
 #: but > 1 so the scan/gate structure is the real fused program's
 AUDIT_SUPERSTEP_K = 2
 
+#: registry program name -> substrings identifying its events in a
+#: ``jax.profiler`` trace (graftscope device-time attribution,
+#: ``obs/device_time.py``). The jitted wrapper functions in
+#: ``run.Experiment.jitted_programs``/``superstep_program`` are named
+#: ``_rollout``/``_insert``/``_train_iter``/``_superstep``; the device
+#: tracks name the XLA module ``jit_<fn>`` while the host executor
+#: track (the only one a CPU trace has — verified against a real
+#: JAX 0.4.37 capture) names the call ``PjitFunction(<fn>)``. Both
+#: forms are listed; the parser attributes one track per program, so
+#: listing both never double-counts. Stable as long as the wrapper
+#: names are (renaming one breaks attribution AND the checked-in GP304
+#: fingerprint, so the programs.json re-baseline is the reminder).
+#: Only the four driver hot programs are attributed:
+#: ``dp_superstep``/``learner_train`` lower the same wrappers (or
+#: ambiguous names) and would double-count.
+TRACE_SYMBOLS = {
+    "rollout": ("jit__rollout", "PjitFunction(_rollout)"),
+    "insert": ("jit__insert", "PjitFunction(_insert)"),
+    "train_iter": ("jit__train_iter", "PjitFunction(_train_iter)"),
+    "superstep": ("jit__superstep", "PjitFunction(_superstep)"),
+}
+
 
 def audit_config():
     """The frozen tiny CPU config all default programs are built on.

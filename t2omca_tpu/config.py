@@ -200,6 +200,38 @@ class ResilienceConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """graftscope runtime-telemetry knobs (docs/OBSERVABILITY.md). All
+    host-side: nothing here touches the jitted programs, so the
+    graftprog fingerprints are identical at any setting — and with
+    ``enabled=False`` (the default) the driver/bench paths are
+    behaviorally identical to a build without the obs layer."""
+
+    # master switch: span recording around every watchdog-stamped
+    # boundary, the spans.jsonl sink, and flight-recorder persistence
+    # on stall/crash/non-finite/SIGTERM. Off by default — telemetry is
+    # opt-in, parity/test configs pay nothing.
+    enabled: bool = False
+    # flight-recorder capacity: the last ring_size completed events
+    # (plus every still-open span) survive into stall_diagnosis.json /
+    # flight_recorder.json
+    ring_size: int = 256
+    # spans.jsonl flush cadence in events (amortizes the write syscall;
+    # the flight ring covers the unflushed tail on a crash)
+    flush_every: int = 32
+    # attribute the jax.profiler window (profile_dir) back to the
+    # registry's named programs: logs device_ms_<program> stats and
+    # writes device_times.json for the report CLI. Needs profile_dir.
+    program_trace: bool = False
+    # Logger per-key in-memory history cap (0 = unbounded, the pre-PR-6
+    # behavior): self.stats held every (t, value) pair for the life of
+    # the run — unbounded host-RAM growth on long runs now that the
+    # JSONL sink is the durable record. print_recent_stats only reads
+    # the last 5 entries, so any cap >= 5 is observationally identical.
+    stats_history: int = 1024
+
+
+@dataclass(frozen=True)
 class TrainConfig:
     """Top-level run flags (reference run-control set, SURVEY.md §5.6)."""
 
@@ -327,6 +359,7 @@ class TrainConfig:
     model: ModelConfig = field(default_factory=ModelConfig)
     replay: ReplayConfig = field(default_factory=ReplayConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
@@ -438,6 +471,29 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
             "resilience.inject_nan_at_step is a fault-injection knob whose "
             "point is exercising the restore escalation — enabling it with "
             "nonfinite_tolerance=0 (escalation off) tests nothing")
+    o = cfg.obs
+    if o.ring_size < 1:
+        raise ValueError(f"obs.ring_size must be >= 1, got {o.ring_size}")
+    if o.flush_every < 1:
+        raise ValueError(f"obs.flush_every must be >= 1, got "
+                         f"{o.flush_every}")
+    if o.stats_history < 0:
+        raise ValueError(f"obs.stats_history must be >= 0 (0 = "
+                         f"unbounded), got {o.stats_history}")
+    if o.program_trace and not cfg.profile_dir:
+        raise ValueError(
+            "obs.program_trace attributes the jax.profiler trace window "
+            "to the registry's programs — with profile_dir empty no "
+            "trace is ever captured and the key is silently dead; set "
+            "profile_dir too")
+    if o.program_trace and not o.enabled:
+        raise ValueError(
+            "obs.program_trace is part of the graftscope telemetry "
+            "layer — with obs.enabled=false the master switch promises "
+            "no telemetry side effects, so the combination is "
+            "contradictory (same dead-knob policy as "
+            "first_dispatch_timeout without dispatch_timeout); set "
+            "obs.enabled=true too")
     if cfg.mixer == "transformer" and cfg.model.mixer_emb != cfg.model.emb:
         raise ValueError(
             "mixer_emb must equal emb: the transformer mixer concatenates "
@@ -467,12 +523,14 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
     model_kw = dict(updates.pop("model", {}) or {})
     replay_kw = dict(updates.pop("replay", {}) or {})
     resilience_kw = dict(updates.pop("resilience", {}) or {})
+    obs_kw = dict(updates.pop("obs", {}) or {})
 
     # route flat keys to their sub-config for reference-style flat configs
     env_fields = {f.name for f in dataclasses.fields(EnvConfig)}
     model_fields = {f.name for f in dataclasses.fields(ModelConfig)}
     replay_fields = {f.name for f in dataclasses.fields(ReplayConfig)}
     resilience_fields = {f.name for f in dataclasses.fields(ResilienceConfig)}
+    obs_fields = {f.name for f in dataclasses.fields(ObsConfig)}
     top_fields = {f.name for f in dataclasses.fields(TrainConfig)}
     flat = dict(updates)
     for k, v in flat.items():
@@ -490,6 +548,9 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
         elif k in resilience_fields:
             resilience_kw.setdefault(k, v)
             updates.pop(k)
+        elif k in obs_fields:
+            obs_kw.setdefault(k, v)
+            updates.pop(k)
         else:
             raise KeyError(f"unknown config key: {k}")
 
@@ -502,6 +563,8 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
     if resilience_kw:
         updates["resilience"] = dataclasses.replace(cfg.resilience,
                                                     **resilience_kw)
+    if obs_kw:
+        updates["obs"] = dataclasses.replace(cfg.obs, **obs_kw)
     return cfg.replace(**updates)
 
 

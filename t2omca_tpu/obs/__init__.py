@@ -1,0 +1,44 @@
+"""graftscope — runtime observability for the training/bench stack.
+
+Four pieces (docs/OBSERVABILITY.md):
+
+* **span tracing** (``spans.py``) — a low-overhead host-side span
+  recorder threaded through every device-facing boundary the watchdog
+  stamps, emitting structured JSONL alongside the Logger sinks;
+* **device-time attribution** (``device_time.py``) — a
+  ``jax.profiler`` trace window that maps captured events back to the
+  registry's named programs (``analysis/registry.TRACE_SYMBOLS``);
+* **flight recorder** (``spans.py``) — a bounded ring of recent
+  events persisted atomically on stall/crash/non-finite/SIGTERM and
+  merged into the watchdog's ``stall_diagnosis.json``;
+* **report CLI** (``python -m t2omca_tpu.obs report <run_dir>``) —
+  joins the runtime telemetry against graftprog's FLOPs/bytes budgets
+  into a roofline-style per-program breakdown.
+
+The span/report half is stdlib-only; ``device_time`` pulls in jax, so
+its names resolve lazily — importing ``t2omca_tpu.obs`` must stay
+cheap enough for the jax-free report CLI.
+"""
+
+from __future__ import annotations
+
+from .spans import (KNOWN_PHASES, NULL_RECORDER, NullRecorder,
+                    SpanRecorder, make_recorder, stacked)
+
+_LAZY = {
+    "ProgramTraceWindow": "device_time",
+    "parse_trace_device_times": "device_time",
+    "PHASE_PROGRAMS": "report",
+    "report_main": "report",
+}
+
+__all__ = ["KNOWN_PHASES", "NULL_RECORDER", "NullRecorder",
+           "SpanRecorder", "make_recorder", "stacked", *sorted(_LAZY)]
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(f".{_LAZY[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

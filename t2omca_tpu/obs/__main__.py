@@ -1,0 +1,50 @@
+"""``python -m t2omca_tpu.obs`` — the graftscope CLI.
+
+Subcommands:
+
+``report <run_dir>``
+    Join the run's span telemetry (``spans.jsonl``) and optional
+    device-time attribution (``device_times.json``) against graftprog's
+    FLOPs/bytes budgets (``analysis/programs.json``) into the per-
+    program roofline table (docs/OBSERVABILITY.md). Exit 0 = report
+    printed, 2 = usage error. Deliberately jax-free — the post-mortem
+    host may not be able to initialize a backend at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m t2omca_tpu.obs",
+        description="graftscope: run telemetry tools "
+                    "(docs/OBSERVABILITY.md)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser(
+        "report", help="per-program roofline report for a recorded run")
+    rep.add_argument("run_dir",
+                     help="results directory of a run recorded with "
+                          "obs.enabled=true (holds spans.jsonl)")
+    rep.add_argument("--programs-json", default=None,
+                     help="graftprog budgets to join against "
+                          "(default: analysis/programs.json)")
+    rep.add_argument("--peak-gflops", type=float, default=None,
+                     help="chip peak GFLOP/s — adds the roofline bound "
+                          "and achieved fraction per program")
+    rep.add_argument("--peak-gbps", type=float, default=None,
+                     help="chip peak memory bandwidth in GB/s (used "
+                          "with --peak-gflops)")
+    args = parser.parse_args(argv)
+    if args.cmd == "report":
+        from .report import report_main
+        return report_main(args.run_dir, args.programs_json,
+                           args.peak_gflops, args.peak_gbps)
+    parser.error(f"unknown command {args.cmd!r}")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

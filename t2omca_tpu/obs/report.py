@@ -1,0 +1,296 @@
+"""graftscope report: join runtime telemetry against graftprog budgets.
+
+``python -m t2omca_tpu.obs report <run_dir>`` reads a run's span
+telemetry (``spans.jsonl``, written by the driver when
+``obs.enabled``) plus its optional device-time attribution
+(``device_times.json``, written by :class:`obs.device_time.
+ProgramTraceWindow`) and joins them against graftprog's checked-in
+FLOPs/bytes budgets (``analysis/programs.json``) into a roofline-style
+per-program table: measured wall (and device) time per dispatch next
+to the program's estimated FLOPs/bytes at the run's shapes, its
+arithmetic intensity, and the achieved FLOP/s — the tool ROADMAP open
+item 1 needs to pick between device-side PER sampling, Pallas
+attention, and bf16 as the next perf target (a program far below the
+intensity-implied bound is latency/dispatch-bound; one near it needs
+less math or fewer bytes, not a faster driver).
+
+Honesty about the join: programs.json budgets are measured at the
+frozen audit config (``analysis/registry.audit_config``: B=2, T=6,
+K=2, train batch 4). The run header mark in ``spans.jsonl`` carries the
+run's shapes, and the report scales the audit budgets linearly with the
+per-dispatch env-step/sample counts — a first-order estimate (marked
+``~``): attention terms scale super-linearly with agents/tokens, so
+cross-*scale* comparisons are indicative, cross-*program* comparisons
+at one scale are solid. Pass ``--peak-gflops``/``--peak-gbps`` (the
+chip's datasheet numbers) to add the roofline bound and the achieved
+fraction.
+
+stdlib-only on purpose (no jax import): the report must run on a host
+that cannot even initialize the backend — that is the post-mortem case
+it exists for.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+#: span phase -> graftprog program name (analysis/programs.json key).
+#: ``dispatch.test`` dispatches the same compiled rollout program as
+#: the train rollout (test_mode is a static arg of one jitted fn), so
+#: it joins the same budgets on its own row.
+PHASE_PROGRAMS = {
+    "dispatch.superstep": "superstep",
+    "dispatch.rollout": "rollout",
+    "dispatch.train": "train_iter",
+    "dispatch.test": "rollout",
+}
+
+
+def load_events(run_dir: str) -> List[dict]:
+    path = os.path.join(run_dir, "spans.jsonl")
+    events: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue            # torn final line (crash mid-write)
+    return events
+
+
+def load_device_times(run_dir: str) -> Dict[str, dict]:
+    path = os.path.join(run_dir, "device_times.json")
+    try:
+        with open(path) as f:
+            return dict(json.load(f).get("programs", {}))
+    except (OSError, ValueError):
+        return {}
+
+
+def run_header(events: List[dict]) -> Optional[dict]:
+    for ev in events:
+        if ev.get("event") == "mark" and ev.get("kind") == "run":
+            return ev
+    return None
+
+
+def phase_summary(events: List[dict]) -> Dict[str, dict]:
+    """Per-phase aggregate from raw span events (same shape as
+    ``SpanRecorder.summary()``, recomputed from the durable JSONL)."""
+    out: Dict[str, dict] = {}
+    for ev in events:
+        if ev.get("event") != "span" or ev.get("open"):
+            continue
+        phase, ms = ev.get("phase"), ev.get("wall_ms")
+        if not isinstance(phase, str) or not isinstance(ms, (int, float)):
+            continue
+        a = out.setdefault(phase, {"n": 0, "total_ms": 0.0, "max_ms": 0.0,
+                                   "first_ms": -1.0, "errors": 0})
+        a["n"] += 1
+        a["total_ms"] += ms
+        a["max_ms"] = max(a["max_ms"], ms)
+        if ev.get("first"):
+            a["first_ms"] = ms
+        if str(ev.get("outcome", "ok")).startswith("error"):
+            a["errors"] += 1
+    for a in out.values():
+        rest_n = a["n"] - (1 if a["first_ms"] >= 0 else 0)
+        rest_total = a["total_ms"] - max(a["first_ms"], 0.0)
+        a["steady_ms"] = rest_total / rest_n if rest_n > 0 else -1.0
+    return out
+
+
+def _audit_shapes() -> dict:
+    """The frozen audit-config shapes the budgets were measured at
+    (jax-free: ``registry.audit_config`` only builds dataclasses)."""
+    from ..analysis.registry import AUDIT_SUPERSTEP_K, audit_config
+    cfg = audit_config()
+    return {"batch_size_run": cfg.batch_size_run,
+            "episode_limit": cfg.env_args.episode_limit,
+            "batch_size": cfg.batch_size,
+            "superstep": AUDIT_SUPERSTEP_K}
+
+
+def scale_factor(program: str, header: Optional[dict],
+                 audit: dict) -> Optional[float]:
+    """First-order budget scale: run per-dispatch work / audit
+    per-dispatch work. None when the header lacks the needed shapes."""
+    if not header:
+        return None
+    try:
+        b = float(header["batch_size_run"]) / audit["batch_size_run"]
+        t = float(header["episode_limit"]) / audit["episode_limit"]
+        if program in ("rollout", "insert"):
+            return b * t
+        if program == "train_iter":
+            return (float(header["batch_size"]) / audit["batch_size"]) * t
+        if program == "superstep":
+            k = float(header.get("superstep", 1)) / audit["superstep"]
+            return k * b * t
+    except (KeyError, TypeError, ZeroDivisionError):
+        return None
+    return None
+
+
+def build_rows(phases: Dict[str, dict], device_times: Dict[str, dict],
+               programs: Dict[str, dict], header: Optional[dict]
+               ) -> List[dict]:
+    audit = _audit_shapes()
+    rows: List[dict] = []
+    for phase, prog_name in PHASE_PROGRAMS.items():
+        p = phases.get(phase)
+        if p is None or p["n"] == 0:
+            continue
+        entry = programs.get(prog_name, {})
+        dev = device_times.get(prog_name, {})
+        sf = scale_factor(prog_name, header, audit)
+        flops = entry.get("flops")
+        bytes_ = entry.get("bytes_accessed")
+        row = {
+            "phase": phase, "program": prog_name, "n": p["n"],
+            "first_ms": p["first_ms"], "steady_ms": p["steady_ms"],
+            "total_ms": p["total_ms"],
+            "device_ms": dev.get("device_ms"),
+            "device_events": dev.get("events"),
+            "flops_audit": flops, "bytes_audit": bytes_,
+            "intensity": (flops / bytes_ if flops and bytes_ else None),
+            "gflop_disp": (flops * sf / 1e9
+                           if flops is not None and sf else None),
+            "gb_disp": (bytes_ * sf / 1e9
+                        if bytes_ is not None and sf else None),
+        }
+        # achieved rate: device time when attributed, else the steady
+        # wall per dispatch (which includes dispatch overhead — an
+        # upper bound on time, lower bound on rate, stated in the table
+        # legend). The trace window covers only its OWN dispatches (not
+        # the whole run's span count), so per-dispatch device time is
+        # the window's median event duration — robust to the compile-
+        # inclusive first call on host tracks; mean over the window's
+        # events is the fallback for older device_times.json files.
+        per_disp_ms = None
+        if dev.get("median_ms"):
+            per_disp_ms = dev["median_ms"]
+            row["time_source"] = "device"
+        elif row["device_ms"] and row["device_events"]:
+            per_disp_ms = row["device_ms"] / row["device_events"]
+            row["time_source"] = "device"
+        elif p["steady_ms"] and p["steady_ms"] > 0:
+            per_disp_ms = p["steady_ms"]
+            row["time_source"] = "wall"
+        row["per_disp_ms"] = per_disp_ms
+        row["achieved_gflops"] = (
+            row["gflop_disp"] / (per_disp_ms / 1000.0)
+            if row["gflop_disp"] and per_disp_ms else None)
+        rows.append(row)
+    return rows
+
+
+def _fmt(v, nd=1, dash="-") -> str:
+    if v is None or (isinstance(v, (int, float)) and v < 0):
+        return dash
+    if isinstance(v, float):
+        return f"{v:,.{nd}f}"
+    return str(v)
+
+
+def render(run_dir: str, events: List[dict], rows: List[dict],
+           phases: Dict[str, dict], header: Optional[dict],
+           peak_gflops: Optional[float], peak_gbps: Optional[float]
+           ) -> str:
+    lines: List[str] = []
+    lines.append(f"graftscope report — {run_dir}")
+    if header:
+        keys = ("backend", "batch_size_run", "episode_limit",
+                "batch_size", "superstep")
+        lines.append("run: " + "  ".join(
+            f"{k}={header[k]}" for k in keys if k in header))
+    else:
+        lines.append("run: (no run header mark in spans.jsonl — budget "
+                     "scaling disabled)")
+    n_spans = sum(1 for e in events if e.get("event") == "span")
+    lines.append(f"events: {len(events)} ({n_spans} spans)")
+    lines.append("")
+    if rows:
+        hdr = (f"{'program':<11}{'phase':<20}{'n':>6}{'first ms':>10}"
+               f"{'ms/disp':>10}{'src':>5}{'~GFLOP/d':>10}{'~GB/d':>8}"
+               f"{'FLOP/B':>8}{'~GFLOP/s':>10}")
+        lines.append(hdr)
+        lines.append("-" * len(hdr))
+        for r in rows:
+            per_disp = r["per_disp_ms"]
+            lines.append(
+                f"{r['program']:<11}{r['phase']:<20}{r['n']:>6}"
+                f"{_fmt(r['first_ms']):>10}{_fmt(per_disp):>10}"
+                f"{r.get('time_source', '-'):>5}"
+                f"{_fmt(r['gflop_disp'], 3):>10}{_fmt(r['gb_disp'], 3):>8}"
+                f"{_fmt(r['intensity']):>8}"
+                f"{_fmt(r['achieved_gflops']):>10}")
+            if peak_gflops and peak_gbps and r["intensity"] \
+                    and r["achieved_gflops"]:
+                bound = min(peak_gflops, r["intensity"] * peak_gbps)
+                lines.append(
+                    f"{'':<11}  roofline bound {bound:,.1f} GFLOP/s "
+                    f"({'compute' if bound == peak_gflops else 'memory'}"
+                    f"-bound) — achieved "
+                    f"{100.0 * r['achieved_gflops'] / bound:.1f}%")
+        lines.append("")
+        lines.append("~ = audit-config budgets (analysis/programs.json) "
+                     "scaled linearly to the run shapes; src=wall "
+                     "includes dispatch overhead (device attribution "
+                     "off — obs.program_trace + profile_dir enable it)")
+    else:
+        lines.append("no program dispatch spans found (was the run "
+                     "recorded with obs.enabled?)")
+    other = {ph: a for ph, a in sorted(phases.items())
+             if ph not in PHASE_PROGRAMS}
+    if other:
+        lines.append("")
+        hdr = (f"{'phase':<22}{'n':>6}{'first ms':>10}{'mean ms':>10}"
+               f"{'max ms':>10}{'total ms':>11}{'errors':>7}")
+        lines.append(hdr)
+        lines.append("-" * len(hdr))
+        for ph, a in other.items():
+            mean = a["total_ms"] / a["n"] if a["n"] else None
+            lines.append(
+                f"{ph:<22}{a['n']:>6}{_fmt(a['first_ms']):>10}"
+                f"{_fmt(mean):>10}{_fmt(a['max_ms']):>10}"
+                f"{_fmt(a['total_ms']):>11}{a['errors']:>7}")
+    return "\n".join(lines)
+
+
+def report_main(run_dir: str, programs_json: Optional[str] = None,
+                peak_gflops: Optional[float] = None,
+                peak_gbps: Optional[float] = None) -> int:
+    """The ``report`` subcommand body. Exit codes match the analysis
+    CLI convention: 0 = report printed, 2 = usage error (missing run
+    dir / unreadable telemetry)."""
+    import sys
+
+    if not os.path.isdir(run_dir):
+        print(f"graftscope: error: {run_dir!r} is not a directory",
+              file=sys.stderr)
+        return 2
+    try:
+        events = load_events(run_dir)
+    except OSError as e:
+        print(f"graftscope: error: no spans.jsonl in {run_dir!r} ({e}); "
+              f"record the run with obs.enabled=true", file=sys.stderr)
+        return 2
+    from ..analysis.baseline import DEFAULT_PROGRAMS, load_programs
+    try:
+        base = load_programs(programs_json or DEFAULT_PROGRAMS)
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        print(f"graftscope: error: unreadable programs baseline: {e}",
+              file=sys.stderr)
+        return 2
+    phases = phase_summary(events)
+    rows = build_rows(phases, load_device_times(run_dir),
+                      base["programs"], run_header(events))
+    print(render(run_dir, events, rows, phases, run_header(events),
+                 peak_gflops, peak_gbps))
+    return 0

@@ -37,6 +37,7 @@ from .learners.qmix_learner import LEARNER_REGISTRY, LearnerState
 from .runners import RUNNER_REGISTRY
 from .runners.episode_runner import EpisodeRunner
 from .runners.parallel_runner import ParallelRunner, RunnerState
+from .obs import spans as obs_spans
 from .utils import resilience, watchdog
 from .utils.checkpoint import (find_checkpoint, load_checkpoint,
                                prune_checkpoints, save_checkpoint)
@@ -421,19 +422,35 @@ def run(cfg: TrainConfig, logger: Optional[Logger] = None) -> TrainState:
     logger.setup_json(results_dir)
     logger.console_logger.info(f"Experiment token: {token}")
 
-    exp = Experiment.build(cfg)
+    # graftscope telemetry (docs/OBSERVABILITY.md): NULL_RECORDER when
+    # obs.enabled is off — every span below is then a shared no-op
+    # context and the driver is behaviorally identical to a build
+    # without the obs layer. The Logger history cap applies regardless
+    # (the unbounded self.stats growth was a bug, not a behavior).
+    logger.max_history = cfg.obs.stats_history
+    rec = obs_spans.make_recorder(cfg.obs, results_dir)
+    # the first jax computation in the build triggers backend init —
+    # the phase BENCH_r03–r05 died in with no telemetry trail
+    with rec.span("backend.init"):
+        exp = Experiment.build(cfg)
     # reference dispatch (per_run.py:192): save_animation alone does NOT
     # divert to evaluation — it enables the in-training animation cadence
     if cfg.evaluate or cfg.save_replay:
+        rec.close()             # eval path records no further spans
         return evaluate_sequential(exp, logger, results_dir)
-    return run_sequential(exp, logger, results_dir)
+    return run_sequential(exp, logger, results_dir, rec=rec)
 
 
 def run_sequential(exp: Experiment, logger: Logger,
-                   results_dir: str) -> TrainState:
+                   results_dir: str,
+                   rec=None) -> TrainState:
     """The train loop (reference ``run_sequential``, ``per_run.py:106-289``)."""
     cfg = exp.cfg
     log = logger.console_logger
+    # graftscope span recorder (``run`` passes its own; direct callers —
+    # tests, evaluate harnesses — get one from the config here)
+    if rec is None:
+        rec = obs_spans.make_recorder(cfg.obs, results_dir)
     env_info = exp.env.get_env_info()
     log.info(f"env_info: {env_info}")
 
@@ -538,7 +555,19 @@ def run_sequential(exp: Experiment, logger: Logger,
         return False
 
     def _on_stall(diag: watchdog.StallDiagnosis) -> None:
-        watchdog.write_diagnosis(diag, model_dir)
+        # the flight-recorder tail rides along in the diagnosis: the
+        # hanging span is still open, so tail() puts it LAST — the
+        # causal trail a wedged BENCH run never used to leave. Guarded:
+        # a telemetry failure here must not abort the callback before
+        # the diagnosis write and the guard trip below — the stall
+        # response outranks its own decoration
+        extra = None
+        if rec.enabled:
+            try:
+                extra = {"recent_spans": rec.tail()}
+            except Exception:  # noqa: BLE001 — diagnostics only
+                log.exception("graftscope: flight tail unavailable")
+        watchdog.write_diagnosis(diag, model_dir, extra=extra)
         # trip the guard BEFORE the save attempt: the emergency save
         # below reads device state over the possibly-wedged backend and
         # can block without raising — with stall_grace_s=0 (no hard
@@ -593,12 +622,20 @@ def run_sequential(exp: Experiment, logger: Logger,
     ladder = watchdog.DegradationLadder(res.max_restores)
     dispatch_faults = 0             # transient dispatch errors seen (stats)
 
-    def _watched(phase, state=None):
-        """One watchdog stamp for a device-facing region (no-op context
-        when the watchdog is disabled) — keeps the wd-None guard and the
-        current-t_env threading in one place instead of at every site."""
-        return (wd.watch(phase, t_env=t_env, state=state)
-                if wd is not None else nullcontext())
+    def _watched(phase, state=None, **meta):
+        """One watchdog stamp + graftscope span for a device-facing
+        region (no-op context when both are disabled) — keeps the
+        wd-None guard, the current-t_env threading, and the telemetry
+        pairing in one place instead of at every site. ``meta`` lands
+        in the span event (attempt counts, K); the watchdog stamp is
+        the OUTER context so a hang inside the span bookkeeping is
+        still bounded."""
+        w = (wd.watch(phase, t_env=t_env, state=state)
+             if wd is not None else None)
+        if rec.enabled:
+            s = rec.span(phase, t_env=t_env, **meta)
+            return obs_spans.stacked(w, s) if w is not None else s
+        return w if w is not None else nullcontext()
 
     last_test_t = t_env - cfg.test_interval - 1
     last_log_t = t_env
@@ -621,8 +658,26 @@ def run_sequential(exp: Experiment, logger: Logger,
     # tracing/profiling (SURVEY.md §5(1)): per-stage wall-clock into the
     # metric stream + optional jax.profiler trace window over the hot loop
     timer = StageTimer()
-    tracer = TraceWindow(cfg.profile_dir, cfg.profile_start,
-                         cfg.profile_iterations)
+    if cfg.obs.program_trace:
+        # graftscope device-time attribution: same trace window, plus a
+        # post-stop parse mapping the captured events back to the
+        # registry's named programs (device_ms_<prog> stats +
+        # device_times.json for the report CLI)
+        from .obs.device_time import ProgramTraceWindow
+        tracer = ProgramTraceWindow(cfg.profile_dir, cfg.profile_start,
+                                    cfg.profile_iterations,
+                                    out_dir=results_dir)
+    else:
+        tracer = TraceWindow(cfg.profile_dir, cfg.profile_start,
+                             cfg.profile_iterations)
+    # run header for the report CLI: the shapes that scale graftprog's
+    # audit-config budgets to this run (obs/report.py)
+    if rec.enabled:
+        rec.mark("run", t_env=t_env, backend=jax.default_backend(),
+                 batch_size_run=cfg.batch_size_run,
+                 episode_limit=cfg.env_args.episode_limit,
+                 batch_size=cfg.batch_size, superstep=K,
+                 host_buffer=exp.host_buffer)
     # per-stage barriers for honest attribution; tracing implies them
     # (an un-synced trace window would capture dispatch, not execution)
     sync_stages = cfg.profile_stages or bool(cfg.profile_dir)
@@ -670,7 +725,7 @@ def run_sequential(exp: Experiment, logger: Logger,
         attempts = (1 + res.dispatch_retries) if retryable else 1
         for attempt in range(1, attempts + 1):
             try:
-                with _watched(phase, state):
+                with _watched(phase, state, attempt=attempt, **context):
                     # the hook fires INSIDE the watched region: an
                     # injected sleep here is indistinguishable from a
                     # hung dispatch to the watchdog (tests rely on this)
@@ -717,8 +772,12 @@ def run_sequential(exp: Experiment, logger: Logger,
         # rolled-back (possibly poisoned) computation, and the replayed
         # iterations will re-push them — flushing the stale ones would
         # both double-count episodes and re-raise the fault at the next
-        # cadence fetch, outside any routing
+        # cadence fetch, outside any routing. The fetch tally survives
+        # the reset: stat_fetches is logged as a cumulative round-trip
+        # counter and must not go backwards across a restore
+        fetches = train_acc.fetches
         train_acc = StatsAccumulator()
+        train_acc.fetches = fetches
         if exp.host_buffer:
             # same hazard for the host-replay deferred priority refs:
             # they came from the rolled-back train step
@@ -747,6 +806,10 @@ def run_sequential(exp: Experiment, logger: Logger,
                            and watchdog.state_intact(ts))
         action = ladder.next_action(can_degrade=can_degrade)
         logger.log_stat("dispatch_failures", ladder.failures, t_env)
+        # ladder actions are span-stream events too: the flight tail
+        # then shows retry exhaustion -> rung taken in causal order
+        rec.mark("ladder", action=action, phase=df.phase, t_env=t_env,
+                 failures=ladder.failures)
         if action == "degrade":
             log.warning(f"degradation ladder: {df} — falling back "
                         f"superstep K={K} -> 1 ({ladder.describe()})")
@@ -763,6 +826,9 @@ def run_sequential(exp: Experiment, logger: Logger,
                 _restore_checkpoint(*good)
                 return
             # no checkpoint to stand on: fall through to abort
+        # abort rung: persist the flight tail next to the checkpoints
+        # (the stall-diagnosis merge covers hangs; this covers failures)
+        rec.persist(os.path.join(model_dir, "flight_recorder.json"))
         # consume the stall diagnosis only on abort: a degrade/restore
         # rung leaves it for the guard-triggered exit log (the causal
         # "stalled call eventually returned" chain) or a later abort
@@ -946,7 +1012,7 @@ def run_sequential(exp: Experiment, logger: Logger,
                 except watchdog.DispatchFailed as df:
                     _dispatch_ladder(df, can_degrade=False)
                     continue
-            tracer.tick(logger)
+            tracer.tick(logger, t_env)
 
             # train-stat cadence: runner_log_interval, epsilon alongside
             # (reference parallel_runner.py:215-219). Deliberately after the
@@ -1110,6 +1176,14 @@ def run_sequential(exp: Experiment, logger: Logger,
                     if not flags.all():
                         logger.log_stat("nonfinite_steps", nonfinite_total,
                                         t_env)
+                        # non-finite trip: event + flight persist, so a
+                        # later divergence abort has the phase history
+                        # leading up to the first trip on disk already
+                        rec.mark("nonfinite", t_env=t_env,
+                                 streak=nonfinite_streak,
+                                 total=nonfinite_total)
+                        rec.persist(os.path.join(results_dir,
+                                                 "flight_recorder.json"))
                         log.warning(
                             f"non-finite loss/grads in "
                             f"{int((~flags).sum())}/{len(flags)} train steps "
@@ -1154,6 +1228,12 @@ def run_sequential(exp: Experiment, logger: Logger,
                     # counters land in _dispatch_ladder as they happen
                     logger.log_stat("dispatch_faults", dispatch_faults,
                                     t_env)
+                if rec.enabled:
+                    # device-fetch accounting (utils/stats.py): how many
+                    # blocking stat round-trips the cadences have cost
+                    logger.log_stat("stat_fetches",
+                                    train_acc.fetches + test_acc.fetches,
+                                    t_env)
                 logger.log_stat("episode", episode, t_env)
                 # wall-clock throughput including everything (train, logging,
                 # cadences) — the honest live rate; the async loop makes the
@@ -1170,6 +1250,15 @@ def run_sequential(exp: Experiment, logger: Logger,
                 logger.print_recent_stats()
                 last_log_t = t_env
 
+    except BaseException as e:
+        # crash path: leave the same causal trail a stall does — the
+        # flight tail with the failing span's phase/outcome last
+        # (best-effort no-ops when telemetry is off; never masks ``e``)
+        rec.mark("crash", t_env=t_env,
+                 error=f"{type(e).__name__}: {e}"[:300])
+        rec.persist(os.path.join(results_dir, "flight_recorder.json"))
+        rec.close()                     # flush the spans.jsonl tail too
+        raise
     finally:
         # stop the watchdog FIRST: the hard-exit grace timer must not be
         # able to kill the process while the orderly emergency checkpoint
@@ -1180,6 +1269,11 @@ def run_sequential(exp: Experiment, logger: Logger,
 
     if guard.triggered:
         # ---- preemption path: lose at most one iteration ---------------
+        # SIGTERM (or watchdog guard trip) is a flight-persist trigger:
+        # the preempted run's last phases survive even if the emergency
+        # checkpoint below cannot be written
+        rec.mark("shutdown", t_env=t_env, signame=guard.signame or "")
+        rec.persist(os.path.join(results_dir, "flight_recorder.json"))
         stall = wd.take_diagnosis() if wd is not None else None
         if stall is not None:
             log.warning(f"watchdog: {stall.message()} — the stalled call "
@@ -1243,6 +1337,7 @@ def run_sequential(exp: Experiment, logger: Logger,
                  f"step selected automatically)")
     else:
         log.info("Finished Training")
+    rec.close()
     return ts
 
 

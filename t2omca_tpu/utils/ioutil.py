@@ -1,0 +1,50 @@
+"""Atomic JSON persistence, shared by the diagnostic writers.
+
+Three places persist post-mortem artifacts — the graftscope flight
+recorder (``obs/spans.py``), the watchdog stall diagnosis
+(``utils/watchdog.py``) and the device-time attribution
+(``obs/device_time.py``) — and each is written on paths (stall, crash,
+hard exit) where a torn or lost file defeats the artifact's purpose.
+One helper so the semantics can't drift between copies:
+
+* tmp + flush + fsync + rename: a hard process exit (or power loss)
+  racing the write never publishes a truncated JSON;
+* ``default=repr``: a non-JSON value smuggled into span meta or a
+  diagnosis field degrades to its repr instead of a ``TypeError``
+  that silently drops the one artifact the post-mortem needs.
+
+Raises propagate (``OSError``/``TypeError``/``ValueError``) — each
+call site owns its best-effort policy (warn, or return None).
+stdlib-only: the jax-free report CLI imports through here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable
+
+
+def write_json_atomic(path: str, payload: Any,
+                      default: Callable[[Any], str] = repr) -> str:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    # unique tmp per call: concurrent writers of the same artifact
+    # (two watchdog stall callbacks run on their own threads) must not
+    # interleave on a shared tmp file — a fixed name would let writer
+    # B truncate A's bytes mid-write and A's rename publish the torn
+    # mix, the exact failure this helper exists to rule out
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f, default=default)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path

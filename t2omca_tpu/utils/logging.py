@@ -30,9 +30,21 @@ def get_console_logger(name: str = "t2omca") -> logging.Logger:
 
 
 class Logger:
-    def __init__(self, console_logger: Optional[logging.Logger] = None):
+    #: default per-key in-memory history cap. ``self.stats`` used to
+    #: keep every (t, value) pair for the life of the run — unbounded
+    #: host-RAM growth on long runs, for a structure whose only reader
+    #: (``print_recent_stats``) looks at the last 5 entries. The JSONL
+    #: sink is the durable record; this cap bounds the live mirror.
+    #: Overridable per instance (``config.ObsConfig.stats_history``
+    #: threads through ``run.run``); 0 = unbounded (the old behavior).
+    DEFAULT_MAX_HISTORY = 1024
+
+    def __init__(self, console_logger: Optional[logging.Logger] = None,
+                 max_history: Optional[int] = None):
         self.console_logger = console_logger or get_console_logger()
         self.stats = defaultdict(list)       # key -> [(t, value)]
+        self.max_history = (self.DEFAULT_MAX_HISTORY
+                            if max_history is None else int(max_history))
         self._tb = None
         self._jsonl = None
 
@@ -56,7 +68,16 @@ class Logger:
     # ---- scalar API ------------------------------------------------------
     def log_stat(self, key: str, value, t: int) -> None:
         value = float(value)
-        self.stats[key].append((t, value))
+        hist = self.stats[key]
+        hist.append((t, value))
+        if self.max_history and len(hist) > self.max_history:
+            # amortized trim: drop down to half the cap so the O(cap)
+            # del runs once per cap/2 appends, not on every append —
+            # but never below the 5 entries print_recent_stats reads
+            # (a cap of 5-9 must stay observationally identical to the
+            # unbounded behavior), and never above the cap itself
+            keep = min(max(self.max_history // 2, 5), self.max_history)
+            del hist[:len(hist) - keep]
         if self._tb is not None:
             self._tb.add_scalar(key, value, t)
         if self._jsonl is not None:

@@ -45,7 +45,12 @@ class StageTimer:
 class TraceWindow:
     """Start a jax profiler trace when ``t_env`` enters
     [start, start+duration_steps-ish]; stop after ``n_iterations`` driver
-    iterations. No-op when ``trace_dir`` is empty."""
+    iterations. No-op when ``trace_dir`` is empty.
+
+    Subclass hook: ``_on_stop(logger, t_env)`` runs once, right after
+    ``jax.profiler.stop_trace()`` — ``obs.device_time.ProgramTraceWindow``
+    overrides it to attribute the captured device time back to the
+    registry's named programs (docs/OBSERVABILITY.md)."""
 
     def __init__(self, trace_dir: str, start_t_env: int = 0,
                  n_iterations: int = 3):
@@ -62,7 +67,7 @@ class TraceWindow:
         jax.profiler.start_trace(self.trace_dir)
         self._active = self.n_iterations
 
-    def tick(self, logger=None) -> None:
+    def tick(self, logger=None, t_env: int = 0) -> None:
         if self._active is None:
             return
         self._active -= 1
@@ -70,6 +75,9 @@ class TraceWindow:
             jax.profiler.stop_trace()
             self._active = None
             self._done = True
-            if logger is not None:
-                logger.console_logger.info(
-                    f"profiler trace written to {self.trace_dir}")
+            self._on_stop(logger, t_env)
+
+    def _on_stop(self, logger, t_env: int) -> None:
+        if logger is not None:
+            logger.console_logger.info(
+                f"profiler trace written to {self.trace_dir}")

@@ -39,6 +39,14 @@ logger = logging.getLogger(__name__)
 # ---------------------------------------------------------------- faults
 
 #: hook point name -> injector callables, fired in registration order.
+#:
+#: The device-facing point names below (``dispatch.*``, ``fetch.*``,
+#: ``collective.gather``, ``backend.init``) double as graftscope span
+#: phases (``obs/spans.KNOWN_PHASES``): when ``config.ObsConfig.enabled``
+#: the driver records a span around the same region each hook fires in,
+#: so an injected fault/hang and its telemetry trail share one name.
+#: graftlint rule GL110 keeps the two sets from drifting apart.
+#:
 #: Known points (each passes keyword context):
 #:   ``checkpoint.staged``   dirname=<staging dir>, t_env=<int>
 #:       after state.msgpack is written+fsynced into the tmp.<t_env>

@@ -52,6 +52,11 @@ class StatsAccumulator:
 
     def __init__(self):
         self.n_episodes = 0
+        #: device→host round-trips this accumulator has performed
+        #: (folds + mid-interval epsilon reads) — graftscope surfaces it
+        #: as ``stat_fetches`` so sync-point cost is attributable from
+        #: telemetry alone (each fetch is ~0.66 s under the axon tunnel)
+        self.fetches = 0
         self._pending = []          # un-fetched RolloutStats device refs
         self._eps_ref = None        # epsilon pushed since the last fetch
         self._eps_val = 0.0         # cached host value
@@ -76,6 +81,7 @@ class StatsAccumulator:
         the full shape product)."""
         if not self._pending:
             return
+        self.fetches += 1
         fetched = jax.device_get(self._pending)
         for s in fetched:
             ret = np.asarray(s.episode_return).reshape(-1)
@@ -101,6 +107,7 @@ class StatsAccumulator:
         which is where cadenced callers should get it."""
         if self._eps_ref is not None:
             # a stacked (K,) superstep push reports its LAST sub-iteration
+            self.fetches += 1
             self._eps_val = float(np.asarray(
                 jax.device_get(self._eps_ref)).reshape(-1)[-1])
             self._eps_ref = None

@@ -41,13 +41,14 @@ millisecond timeouts on CPU (tests/test_watchdog.py, tests/test_chaos.py).
 from __future__ import annotations
 
 import dataclasses
-import json
 import logging
 import os
 import random
 import threading
 import time
 from typing import Any, Callable, Optional
+
+from .ioutil import write_json_atomic
 
 logger = logging.getLogger(__name__)
 
@@ -184,16 +185,24 @@ class StallDiagnosis:
                 f"backend={self.backend})")
 
 
-def write_diagnosis(diag: StallDiagnosis, dirname: str) -> Optional[str]:
+def write_diagnosis(diag: StallDiagnosis, dirname: str,
+                    extra: Optional[dict] = None) -> Optional[str]:
     """Persist ``dirname/stall_diagnosis.json`` (best-effort: diagnosis
-    must never be the thing that crashes the diagnostic path)."""
+    must never be the thing that crashes the diagnostic path).
+    ``extra`` is merged into the payload — the driver passes the
+    graftscope flight-recorder tail as ``recent_spans`` (the hanging
+    span last, docs/OBSERVABILITY.md), so a wedged run's causal trail
+    lands in the same file as its diagnosis. Written via
+    ``write_json_atomic`` (tmp + fsync + rename, ``default=repr``): a
+    hard exit racing the write must not publish a torn JSON, and a
+    non-JSON span-meta value must not cost the whole diagnosis."""
     try:
-        os.makedirs(dirname, exist_ok=True)
-        path = os.path.join(dirname, "stall_diagnosis.json")
-        with open(path, "w") as f:
-            json.dump(diag.to_dict(), f)
-        return path
-    except OSError as e:            # pragma: no cover - disk-full etc.
+        payload = diag.to_dict()
+        if extra:
+            payload.update(extra)
+        return write_json_atomic(
+            os.path.join(dirname, "stall_diagnosis.json"), payload)
+    except (OSError, TypeError, ValueError) as e:  # pragma: no cover
         logger.warning("could not persist stall diagnosis: %s", e)
         return None
 

@@ -374,6 +374,63 @@ def f(x):
     assert rules_of(src) == []
 
 
+# --------------------------------------------------------------- GL110
+
+_GL110_SRC = """
+def loop(_watched, _sync_point, _dispatch):
+    with _watched("dispatch.superstep", None):
+        pass
+    _sync_point("fetch.train_stats", lambda: None)
+    _dispatch("dispatch.bogus", lambda: None, None)
+    _dispatch(phase="fetch.bogus", fn=lambda: None)
+"""
+
+
+def test_gl110_unregistered_phase_flagged():
+    phases = {"dispatch.superstep", "fetch.train_stats"}
+    fs = lint_source(_GL110_SRC, "fixture.py", span_phases=phases)
+    assert sorted(f.rule for f in fs) == ["GL110", "GL110"]
+    msgs = " | ".join(f.message for f in fs)
+    assert "dispatch.bogus" in msgs           # positional literal
+    assert "fetch.bogus" in msgs              # phase= keyword literal
+    assert "KNOWN_PHASES" in msgs
+
+
+def test_gl110_disabled_without_registry_and_skips_dynamic():
+    # no span_phases (registry absent) -> rule disarmed entirely
+    assert lint_source(_GL110_SRC, "fixture.py") == []
+    # dynamic phases are invisible to AST: never flagged
+    src = """
+def f(_watched, name):
+    with _watched(name, None):
+        pass
+"""
+    assert lint_source(src, "fixture.py", span_phases=set()) == []
+
+
+def test_gl110_registry_parsed_from_spans_module(tmp_path):
+    """``lint_package`` arms GL110 from the real obs/spans.py — parsed
+    by AST, never imported — and the real driver is clean against it."""
+    from t2omca_tpu.analysis.graftlint import collect_span_phases
+    phases = collect_span_phases(REPO)
+    assert phases is not None
+    assert "dispatch.superstep" in phases and "bench.probe" in phases
+    # a repo without the registry file disarms the rule (None)
+    assert collect_span_phases(tmp_path) is None
+    # and an unregistered phase in a package file WOULD be a gate
+    # failure: prove the plumbing end-to-end through lint_package
+    pkg = tmp_path / "t2omca_tpu"
+    (pkg / "obs").mkdir(parents=True)
+    (pkg / "obs" / "spans.py").write_text(
+        'KNOWN_PHASES = frozenset({"dispatch.good"})\n')
+    (pkg / "driver.py").write_text(_GL110_SRC.replace(
+        "dispatch.superstep", "dispatch.good"))
+    found = lint_package(tmp_path)
+    gl110 = [f for f in found if f.rule == "GL110"]
+    assert {f.message.split("'")[1] for f in gl110} == \
+        {"dispatch.bogus", "fetch.bogus", "fetch.train_stats"}
+
+
 # ---------------------------------------------------------- suppression
 
 def test_inline_suppression_and_skip_file():

@@ -39,6 +39,27 @@ def test_default_line_schema():
     assert rec["config"] is None
 
 
+@pytest.mark.slow   # subprocess + fresh jit; rides the same smoke run shape
+def test_span_summary_embedded_in_record():
+    """graftscope satellite (docs/OBSERVABILITY.md): every BENCH record
+    embeds the per-phase span summary — build, compile (the first
+    dispatch), warm, and the steady-state measure phase — so a
+    BENCH_r*.json says where its wall-clock went."""
+    rec = run_bench()
+    spans = rec["spans"]
+    for phase in ("bench.build", "bench.compile", "bench.warm",
+                  "bench.measure"):
+        assert phase in spans, (phase, sorted(spans))
+        assert spans[phase]["n"] >= 1
+        assert spans[phase]["total_ms"] > 0
+    # the measure phase ran the timed iterations: first_ms isolates the
+    # first timed run, steady_ms the warm median's neighborhood
+    assert spans["bench.measure"]["n"] >= 3
+    assert spans["bench.measure"]["steady_ms"] > 0
+    # compile dominates warm on a fresh subprocess
+    assert spans["bench.compile"]["first_ms"] > spans["bench.warm"]["first_ms"]
+
+
 @pytest.mark.slow   # two subprocess benches; the acting flag plumbing is pure argparse
 @pytest.mark.parametrize("acting", ["qslice", "dense"])
 def test_acting_selector_reported(acting):

@@ -1,0 +1,559 @@
+"""graftscope observability layer (``t2omca_tpu/obs``,
+docs/OBSERVABILITY.md): span recorder schema/nesting/overhead, flight-
+recorder tail ordering + atomic persistence, the profiler-trace →
+program attribution parser, the report CLI against a seeded run dir,
+the Logger history cap, and — slow-marked — driver integration: an
+injected stall/crash/SIGTERM must each leave the flight trail the layer
+exists to provide (the stall's ``stall_diagnosis.json`` carrying
+``recent_spans`` with the hanging span last is the PR acceptance
+criterion)."""
+
+import ast
+import glob
+import gzip
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from t2omca_tpu.config import (EnvConfig, ModelConfig, ObsConfig,
+                               ReplayConfig, ResilienceConfig, TrainConfig,
+                               sanity_check)
+from t2omca_tpu.obs.spans import (KNOWN_PHASES, NULL_RECORDER,
+                                  SpanRecorder, make_recorder, stacked)
+from t2omca_tpu.utils.logging import Logger
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+# ---------------------------------------------------------------------------
+# span recorder (jit-free units)
+# ---------------------------------------------------------------------------
+
+def test_span_schema_and_jsonl_roundtrip(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    rec = SpanRecorder(ring_size=16, jsonl_path=path, flush_every=1)
+    rec.mark("run", backend="cpu", superstep=4)
+    with rec.span("dispatch.superstep", t_env=48, attempt=1, k=4):
+        pass
+    with rec.span("dispatch.superstep", t_env=96, attempt=1, k=4):
+        pass
+    rec.close()
+    events = [json.loads(l) for l in open(path)]
+    assert [e["event"] for e in events] == ["mark", "span", "span"]
+    mark, first, second = events
+    assert mark["kind"] == "run" and mark["superstep"] == 4
+    for e in (first, second):
+        assert e["phase"] == "dispatch.superstep"
+        assert e["outcome"] == "ok"
+        assert e["attempt"] == 1 and e["k"] == 4
+        assert isinstance(e["wall_ms"], float) and e["wall_ms"] >= 0
+        assert e["depth"] == 0
+    # the first clean completion of a phase is the compile-inclusive
+    # one (the watchdog's compile exemption, made measurable)
+    assert first.get("first") is True
+    assert "first" not in second
+    assert first["seq"] < second["seq"]
+    assert first["t_env"] == 48 and second["t_env"] == 96
+
+
+def test_span_nesting_error_outcome_and_summary():
+    rec = SpanRecorder()
+    with pytest.raises(ValueError):
+        with rec.span("checkpoint.save", t_env=1):
+            with rec.span("collective.gather", t_env=1):
+                raise ValueError("torn write")
+    with rec.span("checkpoint.save", t_env=2):
+        pass
+    tail = rec.tail()
+    inner = next(e for e in tail if e["phase"] == "collective.gather")
+    outer_err = next(e for e in tail if e["phase"] == "checkpoint.save"
+                     and e["outcome"] != "ok")
+    assert inner["depth"] == 1 and inner["outcome"] == "error:ValueError"
+    assert outer_err["depth"] == 0
+    # an exception is NOT a completion: first_ms belongs to the first
+    # CLEAN occurrence (matching Watchdog.clear(completed=...))
+    s = rec.summary()["checkpoint.save"]
+    assert s["n"] == 2
+    assert s["first_ms"] >= 0
+    ok = next(e for e in tail if e["phase"] == "checkpoint.save"
+              and e["outcome"] == "ok")
+    assert ok.get("first") is True
+
+
+def test_flight_tail_open_span_last_and_persist_atomic(tmp_path):
+    rec = SpanRecorder(ring_size=4)
+    for i in range(6):                       # overflow the ring
+        with rec.span("fetch.train_stats", t_env=i):
+            pass
+    hang = rec.span("dispatch.superstep", t_env=99)
+    hang.__enter__()                         # stalled: never exits
+    time.sleep(0.01)
+    tail = rec.tail()
+    assert len(tail) == 5                    # 4 ring + 1 open
+    assert tail[-1]["phase"] == "dispatch.superstep"
+    assert tail[-1]["open"] is True
+    assert tail[-1]["wall_ms"] >= 10.0       # elapsed-so-far, not zero
+    assert all("open" not in e for e in tail[:-1])
+    # atomic persist replaces whatever was there (no torn JSON)
+    target = str(tmp_path / "flight_recorder.json")
+    with open(target, "w") as f:
+        f.write("{'torn")
+    assert rec.persist(target) == target
+    data = json.load(open(target))
+    assert data["events"][-1]["phase"] == "dispatch.superstep"
+    assert not os.path.exists(target + ".tmp")
+    hang.__exit__(None, None, None)
+
+
+def test_null_recorder_and_make_recorder(tmp_path):
+    assert NULL_RECORDER.enabled is False
+    with NULL_RECORDER.span("dispatch.rollout", t_env=3):
+        pass
+    NULL_RECORDER.mark("run")
+    assert NULL_RECORDER.tail() == []
+    assert NULL_RECORDER.persist(str(tmp_path / "x.json")) is None
+    assert not (tmp_path / "x.json").exists()
+    # config plumbing: disabled -> the shared null recorder, no files
+    assert make_recorder(ObsConfig(), str(tmp_path)) is NULL_RECORDER
+    rec = make_recorder(ObsConfig(enabled=True, ring_size=7),
+                        str(tmp_path))
+    assert rec.enabled and rec.ring_size == 7
+    assert rec.jsonl_path == str(tmp_path / "spans.jsonl")
+
+
+def test_stacked_context_order_and_error_propagation():
+    order = []
+
+    class Ctx:
+        def __init__(self, name):
+            self.name = name
+
+        def __enter__(self):
+            order.append(("enter", self.name))
+
+        def __exit__(self, exc_type, *exc):
+            order.append(("exit", self.name,
+                          exc_type.__name__ if exc_type else None))
+
+    with pytest.raises(RuntimeError):
+        with stacked(Ctx("watchdog"), Ctx("span")):
+            raise RuntimeError("x")
+    # watchdog stamp is the OUTER context: entered first, exited last,
+    # and both see the exception
+    assert order == [("enter", "watchdog"), ("enter", "span"),
+                     ("exit", "span", "RuntimeError"),
+                     ("exit", "watchdog", "RuntimeError")]
+
+
+def test_span_overhead_under_budget(tmp_path):
+    """Acceptance: span recording must cost < 1% of a steady-state
+    iteration. The CPU smoke config's warm superstep dispatch is tens
+    of ms and carries ~3 spans — so the per-span budget is generous;
+    assert a hard per-span ceiling loose enough for a loaded CI box
+    (measured ~5 µs enabled, ~0.2 µs disabled; docs/OBSERVABILITY.md)."""
+    n = 2000
+    rec = SpanRecorder(ring_size=64,
+                       jsonl_path=str(tmp_path / "spans.jsonl"),
+                       flush_every=32)
+    t0 = time.perf_counter()
+    for i in range(n):
+        with rec.span("dispatch.superstep", t_env=i, attempt=1):
+            pass
+    per_span = (time.perf_counter() - t0) / n
+    rec.close()
+    assert per_span < 500e-6, f"enabled span cost {per_span * 1e6:.1f}µs"
+    t0 = time.perf_counter()
+    for i in range(n):
+        with NULL_RECORDER.span("dispatch.superstep", t_env=i):
+            pass
+    per_null = (time.perf_counter() - t0) / n
+    assert per_null < 50e-6, f"disabled span cost {per_null * 1e6:.1f}µs"
+
+
+# ---------------------------------------------------------------------------
+# hook coverage: every driver/bench span phase is registered
+# ---------------------------------------------------------------------------
+
+def _literal_phases(path, fn_names=(), span_attrs=("span",)):
+    """Literal first-arg phases of wrapper calls (``_watched(...)``) and
+    recorder ``.span(...)`` attribute calls in one source file."""
+    tree = ast.parse(open(path).read())
+    phases = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name_hit = (isinstance(node.func, ast.Name)
+                    and node.func.id in fn_names)
+        attr_hit = (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in span_attrs)
+        if not (name_hit or attr_hit):
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            phases.add(node.args[0].value)
+    return phases
+
+
+def test_every_driver_phase_is_registered():
+    """The GL110 contract, asserted directly (the lint prelude enforces
+    it too — this is the in-suite meta-test the satellite asks for):
+    every watchdog-stamped phase in run.py and every bench span phase
+    is in obs/spans.KNOWN_PHASES, so each has flight coverage."""
+    driver = _literal_phases(
+        os.path.join(REPO, "t2omca_tpu", "run.py"),
+        fn_names=("_watched", "_sync_point", "_dispatch"))
+    assert driver, "driver phase scan found nothing — scan broken?"
+    assert driver <= KNOWN_PHASES, driver - KNOWN_PHASES
+    bench = _literal_phases(os.path.join(REPO, "bench.py"))
+    assert {"bench.probe", "bench.build", "bench.compile",
+            "bench.measure"} <= bench
+    assert bench <= KNOWN_PHASES, bench - KNOWN_PHASES
+    # the resilience hook table and the span registry stay aligned for
+    # the dispatch/fetch boundaries both name
+    from t2omca_tpu.utils import resilience  # noqa: F401 — doc anchor
+    for phase in ("dispatch.superstep", "dispatch.rollout",
+                  "dispatch.train", "dispatch.test", "dispatch.wait",
+                  "fetch.train_infos", "fetch.train_stats",
+                  "fetch.test_stats", "collective.gather",
+                  "backend.init"):
+        assert phase in KNOWN_PHASES, phase
+
+
+# ---------------------------------------------------------------------------
+# device-time attribution parser (synthetic trace — no profiler needed)
+# ---------------------------------------------------------------------------
+
+def test_parse_trace_device_times_synthetic(tmp_path):
+    from t2omca_tpu.obs.device_time import parse_trace_device_times
+    d = tmp_path / "plugins" / "profile" / "2026_08_03"
+    d.mkdir(parents=True)
+    trace = {"traceEvents": [
+        # host executor track (pid 1): PjitFunction form, with a
+        # nested same-call duplicate (observed on real CPU traces) —
+        # the dedupe must count ONE call, and symbol rank must prefer
+        # the device-module form below over this host track
+        {"ph": "X", "pid": 1, "tid": 7, "ts": 0,
+         "name": "PjitFunction(_superstep)", "dur": 9000},
+        {"ph": "X", "pid": 1, "tid": 7, "ts": 1,
+         "name": "PjitFunction(_superstep)", "dur": 8998},
+        # device track (pid 2): the real execution time — attribution
+        # must pick this (rank-0 symbol), not sum host+device
+        {"ph": "X", "pid": 2, "ts": 0, "name": "XlaModule jit__superstep",
+         "dur": 4000},
+        {"ph": "X", "pid": 2, "ts": 5000,
+         "name": "XlaModule jit__superstep", "dur": 6000},
+        {"ph": "X", "pid": 2, "ts": 12000,
+         "name": "XlaModule jit__rollout", "dur": 1500},
+        # incomplete / unrelated events are ignored
+        {"ph": "B", "pid": 2, "name": "jit__rollout"},
+        {"ph": "X", "pid": 2, "ts": 0, "name": "something_else",
+         "dur": 9999},
+    ]}
+    with gzip.open(d / "host.trace.json.gz", "wt") as f:
+        json.dump(trace, f)
+    out = parse_trace_device_times(str(tmp_path))
+    assert out["superstep"] == {"device_ms": 10.0, "events": 2,
+                                "median_ms": 6.0}
+    assert out["rollout"] == {"device_ms": 1.5, "events": 1,
+                              "median_ms": 1.5}
+    assert "train_iter" not in out          # no events, no entry
+    # empty dir: no events, no crash
+    assert parse_trace_device_times(str(tmp_path / "nope")) == {}
+
+
+# ---------------------------------------------------------------------------
+# report CLI against a seeded run dir (jax-free)
+# ---------------------------------------------------------------------------
+
+def _seed_run_dir(tmp_path, with_device_times=False):
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    events = [{"event": "mark", "kind": "run", "seq": 1, "t0": 0.0,
+               "backend": "cpu", "batch_size_run": 2, "episode_limit": 6,
+               "batch_size": 4, "superstep": 4}]
+    seq = 2
+    for i in range(4):
+        events.append({"event": "span", "seq": seq, "t0": 0.0,
+                       "phase": "dispatch.superstep", "t_env": 48 * i,
+                       "depth": 0, "wall_ms": 5000.0 if i == 0 else 100.0,
+                       "outcome": "ok", **({"first": True} if i == 0
+                                           else {})})
+        seq += 1
+    events.append({"event": "span", "seq": seq, "t0": 0.0,
+                   "phase": "fetch.train_stats", "t_env": 192, "depth": 0,
+                   "wall_ms": 2.0, "outcome": "ok", "first": True})
+    with open(run_dir / "spans.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    if with_device_times:
+        with open(run_dir / "device_times.json", "w") as f:
+            json.dump({"version": 1, "t_env": 192, "programs": {
+                "superstep": {"device_ms": 240.0, "events": 3}}}, f)
+    return run_dir
+
+
+def test_report_cli_joins_spans_and_budgets(tmp_path, capsys):
+    from t2omca_tpu.obs.__main__ import main
+    rc = main(["report", str(_seed_run_dir(tmp_path))])
+    out = capsys.readouterr().out
+    assert rc == 0
+    # the per-program join: measured wall next to programs.json budgets
+    assert "superstep" in out and "dispatch.superstep" in out
+    assert "wall" in out                      # time source column
+    assert "5,000.0" in out                   # first (compile) ms
+    assert "100.0" in out                     # steady ms/dispatch
+    assert "FLOP/B" in out                    # budget-side columns joined
+    assert "fetch.train_stats" in out         # non-program phase table
+    assert "superstep=4" in out               # run header echoed
+
+
+def test_report_cli_device_times_and_roofline(tmp_path, capsys):
+    from t2omca_tpu.obs.__main__ import main
+    run_dir = _seed_run_dir(tmp_path, with_device_times=True)
+    rc = main(["report", str(run_dir), "--peak-gflops", "100",
+               "--peak-gbps", "50"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "device" in out                    # device attribution used
+    assert "roofline bound" in out and "%" in out
+
+
+def test_report_cli_usage_errors(tmp_path, capsys):
+    from t2omca_tpu.obs.__main__ import main
+    assert main(["report", str(tmp_path / "missing")]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main(["report", str(empty)]) == 2   # no spans.jsonl
+
+
+@pytest.mark.slow   # subprocess import check (~2 s interpreter startup)
+def test_report_cli_is_jax_free():
+    """The report must run on a host that cannot initialize a backend —
+    the post-mortem case it exists for — so importing it must not pull
+    in jax."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import t2omca_tpu.obs.report, t2omca_tpu.obs.__main__, sys; "
+         "assert 'jax' not in sys.modules, 'report imports jax'"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+
+
+# ---------------------------------------------------------------------------
+# Logger history cap (satellite: unbounded self.stats growth)
+# ---------------------------------------------------------------------------
+
+def test_logger_history_is_capped():
+    logger = Logger(max_history=64)
+    for i in range(1000):
+        logger.log_stat("loss", float(i), i)
+    hist = logger.stats["loss"]
+    assert len(hist) <= 64
+    assert hist[-1] == (999, 999.0)           # newest entries survive
+    # print_recent_stats (the only reader) still works on the tail
+    logger.print_recent_stats()
+    # 0 = unbounded (the pre-cap behavior, explicitly opt-in)
+    unbounded = Logger(max_history=0)
+    for i in range(3000):
+        unbounded.log_stat("loss", float(i), i)
+    assert len(unbounded.stats["loss"]) == 3000
+    assert Logger().max_history == Logger.DEFAULT_MAX_HISTORY
+
+
+def test_obs_config_sanity():
+    base = TrainConfig()
+    assert base.obs.enabled is False          # telemetry is opt-in
+    for bad in (dict(ring_size=0), dict(flush_every=0),
+                dict(stats_history=-1), dict(program_trace=True)):
+        with pytest.raises(ValueError):
+            sanity_check(TrainConfig(obs=ObsConfig(**bad)))
+    # program_trace without the master switch contradicts the
+    # enabled=False no-telemetry contract (dead-knob policy)
+    with pytest.raises(ValueError):
+        sanity_check(TrainConfig(profile_dir="/tmp/x",
+                                 obs=ObsConfig(program_trace=True)))
+    # valid with BOTH the profiler window and the master switch
+    sanity_check(TrainConfig(profile_dir="/tmp/x",
+                             obs=ObsConfig(enabled=True,
+                                           program_trace=True)))
+
+
+# ---------------------------------------------------------------------------
+# driver integration (tiny CPU configs; slow — full run() legs)
+# ---------------------------------------------------------------------------
+
+def tiny_cfg(tmp_path, **kw):
+    res_kw = kw.pop("res_kw", {})
+    obs_kw = kw.pop("obs_kw", {})
+    defaults = dict(
+        t_max=60, batch_size_run=2, batch_size=4, test_interval=1_000_000,
+        test_nepisode=2, log_interval=12, runner_log_interval=12,
+        save_model=True, save_model_interval=12,
+        local_results_path=str(tmp_path), use_tensorboard=False,
+        epsilon_anneal_time=50,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=6),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1),
+        replay=ReplayConfig(buffer_size=8),
+        resilience=ResilienceConfig(stall_grace_s=0.0, **res_kw),
+        obs=ObsConfig(enabled=True, flush_every=1, **obs_kw),
+    )
+    defaults.update(kw)
+    return sanity_check(TrainConfig(**defaults))
+
+
+def _run_dir(tmp_path):
+    dirs = [d for d in glob.glob(os.path.join(str(tmp_path), "*"))
+            if os.path.isdir(d) and os.path.basename(d) != "models"]
+    assert len(dirs) == 1, dirs
+    return dirs[0]
+
+
+def _span_events(run_dir):
+    with open(os.path.join(run_dir, "spans.jsonl")) as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+@pytest.fixture()
+def _no_fault_leaks():
+    from t2omca_tpu.utils import resilience
+    resilience.clear_faults()
+    yield
+    resilience.clear_faults()
+
+
+@pytest.mark.slow
+@pytest.mark.faultinject
+def test_stall_diagnosis_carries_flight_tail(tmp_path, _no_fault_leaks):
+    """Acceptance: an injected hang in ``dispatch.superstep`` leaves a
+    ``stall_diagnosis.json`` containing the flight-recorder tail with
+    the hanging span LAST (open, wall-so-far >= the watchdog timeout).
+    The diagnosis is written by the watchdog thread WHILE the main
+    thread is still wedged — the post-mortem trail a wedged BENCH run
+    never used to leave."""
+    import jax  # noqa: F401 — ensures backend up before timing
+    from t2omca_tpu.run import run
+    from t2omca_tpu.utils import resilience
+
+    cfg = tiny_cfg(tmp_path, superstep=2,
+                   res_kw=dict(dispatch_timeout=0.75))
+    hung = []
+
+    def _hang(t_env, **kw):
+        if t_env >= 24 and not hung:
+            hung.append(t_env)
+            time.sleep(2.5)
+
+    resilience.register_fault("dispatch.superstep", _hang)
+    run(cfg, Logger())
+    assert hung == [24]
+    model_dir = glob.glob(os.path.join(str(tmp_path), "models", "*"))[0]
+    with open(os.path.join(model_dir, "stall_diagnosis.json")) as f:
+        diag = json.load(f)
+    assert diag["phase"] == "dispatch.superstep"
+    spans = diag["recent_spans"]
+    assert spans, "flight tail missing from the diagnosis"
+    last = spans[-1]
+    assert last["phase"] == "dispatch.superstep"
+    assert last["open"] is True
+    assert last["t_env"] == 24
+    assert last["wall_ms"] >= cfg.resilience.dispatch_timeout * 1000.0
+    # everything before the hang is a completed span/mark
+    assert all(not e.get("open") for e in spans[:-1])
+    # the run's own span stream also recorded warm dispatches first
+    events = _span_events(_run_dir(tmp_path))
+    phases = {e.get("phase") for e in events if e["event"] == "span"}
+    assert "dispatch.superstep" in phases
+
+
+@pytest.mark.slow
+@pytest.mark.faultinject
+def test_crash_persists_flight_recorder(tmp_path, _no_fault_leaks):
+    from t2omca_tpu.run import run
+    from t2omca_tpu.utils import resilience
+
+    cfg = tiny_cfg(tmp_path)
+
+    def _boom(t_env, **kw):
+        if t_env >= 24:
+            raise RuntimeError("deterministic bug, nothing to retry")
+
+    resilience.register_fault("driver.iteration", _boom)
+    with pytest.raises(RuntimeError, match="nothing to retry"):
+        run(cfg, Logger())
+    run_dir = _run_dir(tmp_path)
+    flight = json.load(open(os.path.join(run_dir,
+                                         "flight_recorder.json")))
+    assert flight["events"], "crash left an empty flight recorder"
+    crash = [e for e in flight["events"]
+             if e["event"] == "mark" and e["kind"] == "crash"]
+    assert crash and "nothing to retry" in crash[0]["error"]
+    # the dispatches leading up to the crash are in the tail
+    assert any(e.get("phase") == "dispatch.rollout"
+               for e in flight["events"])
+
+
+@pytest.mark.slow
+@pytest.mark.faultinject
+def test_sigterm_persists_flight_and_span_coverage(tmp_path,
+                                                   _no_fault_leaks):
+    """SIGTERM flight persistence, plus the runtime half of the
+    hook-coverage meta-test: every phase the classic loop dispatches
+    shows up as a completed span in spans.jsonl."""
+    from t2omca_tpu.run import run
+    from t2omca_tpu.utils import resilience
+
+    cfg = tiny_cfg(tmp_path)
+
+    def _preempt(t_env, guard=None, **kw):
+        if t_env >= 36 and guard is not None:
+            guard.request("test-sigterm")
+
+    resilience.register_fault("driver.iteration", _preempt)
+    run(cfg, Logger())
+    run_dir = _run_dir(tmp_path)
+    flight = json.load(open(os.path.join(run_dir,
+                                         "flight_recorder.json")))
+    kinds = [e["kind"] for e in flight["events"]
+             if e["event"] == "mark"]
+    assert "shutdown" in kinds
+    events = _span_events(run_dir)
+    phases = {e.get("phase") for e in events if e["event"] == "span"}
+    # classic-loop coverage: rollout + train dispatches, the stat
+    # fetches, the checkpoint save, and the startup backend init
+    for expect in ("backend.init", "dispatch.rollout", "dispatch.train",
+                   "fetch.train_stats", "checkpoint.save"):
+        assert expect in phases, (expect, sorted(phases))
+    assert phases <= KNOWN_PHASES, phases - KNOWN_PHASES
+    # outcome bookkeeping: clean run, no error spans
+    assert all(e["outcome"] == "ok" for e in events
+               if e["event"] == "span")
+
+
+@pytest.mark.slow
+def test_report_cli_on_real_smoke_run(tmp_path):
+    """Acceptance: ``python -m t2omca_tpu.obs report`` on a CPU smoke
+    run (tiny config, superstep=4) prints the per-program table joining
+    measured wall time with the graftprog budgets."""
+    from t2omca_tpu.obs.__main__ import main
+    from t2omca_tpu.run import run
+
+    cfg = tiny_cfg(tmp_path, superstep=4, save_model=False,
+                   save_model_interval=1_000_000, t_max=96)
+    run(cfg, Logger())
+    run_dir = _run_dir(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "t2omca_tpu.obs", "report", run_dir],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    out = proc.stdout
+    assert "superstep" in out and "dispatch.superstep" in out
+    assert "FLOP/B" in out
+    assert "superstep=4" in out
+    # in-process too (covers the argparse path without a subprocess)
+    assert main(["report", run_dir]) == 0
