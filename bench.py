@@ -728,6 +728,96 @@ def bench_prod_hbm(cfg) -> int:
     return 0
 
 
+def bench_serve(args) -> int:
+    """``--serve``: the serving-path measurement (ROADMAP item 5).
+
+    Loads an exported artifact (``python -m t2omca_tpu.serve export``)
+    through the production front-end and measures what traffic sees:
+
+    * **p50/p99 decision latency** — per-request wall time of
+      ``ServeFrontend.select`` over a deterministic ragged request
+      schedule that crosses every bucket boundary (size 1, each bucket,
+      each bucket's boundary+1 — the worst padding waste points);
+    * **decisions/s/chip** — steady-state agent-decisions per second at
+      the largest bucket with the hidden state carried between requests
+      (the recurrent-policy serving loop).
+
+    One BENCH-style JSON line; a failure anywhere still emits the
+    partial record with the open phase + flight tail (``main_flight``),
+    like every training leg. The record carries the live backend —
+    a ``--smoke`` (CPU-pinned) serve measurement can never masquerade
+    as a chip number."""
+    import jax
+
+    from t2omca_tpu.serve.frontend import ServeFrontend
+
+    with _REC.span("bench.build", leg="serve"):
+        fe = ServeFrontend.load(args.artifact, dtype=args.serve_dtype,
+                                rec=_REC)
+    a, d, na = fe.n_agents, fe.obs_dim, fe.n_actions
+    rng = np.random.default_rng(0)
+
+    def request(n):
+        obs = rng.standard_normal((n, a, d)).astype(np.float32)
+        avail = rng.random((n, a, na)) < 0.7
+        avail[..., 0] = True            # every agent keeps a legal action
+        return obs, avail
+
+    with _REC.span("bench.compile", leg="serve"):
+        fe.warmup()                     # one dispatch per bucket
+
+    # ragged schedule crossing every bucket boundary (dedup, sorted)
+    sizes = sorted({1, *fe.buckets,
+                    *(b + 1 for b in fe.buckets[:-1])})
+    reqs = {n: request(n) for n in sizes}
+    # enough samples for an honest p99 tail
+    reps = max(args.iters, -(-100 // len(sizes)))
+    lat_ms = []
+    with _REC.span("bench.measure", leg="serve"):
+        for _ in range(reps):
+            for n in sizes:
+                obs, avail = reqs[n]
+                t0 = time.perf_counter()
+                fe.select(obs, avail)
+                lat_ms.append((time.perf_counter() - t0) * 1e3)
+        p50, p99 = np.percentile(lat_ms, [50, 99])
+
+        # throughput leg: hidden-carried steady state at the max bucket
+        bmax = fe.buckets[-1]
+        obs, avail = reqs[bmax]
+        _, hidden = fe.select(obs, avail)          # extra warm, fresh h
+        k = max(3 * args.iters, 10)
+        t0 = time.perf_counter()
+        for _ in range(k):
+            actions, hidden = fe.select(obs, avail, hidden)
+        dt = time.perf_counter() - t0
+    decisions = k * bmax * a / dt
+    print(f"# serve latency over {len(lat_ms)} requests "
+          f"(sizes {sizes}): p50 {p50:.2f} ms, p99 {p99:.2f} ms",
+          file=sys.stderr)
+    print(f"# serve throughput at bucket {bmax}: "
+          f"{decisions:,.0f} decisions/s ({a} agents/request, "
+          f"hidden carried)", file=sys.stderr)
+    print(json.dumps({
+        "metric": "serve_decisions_per_sec",
+        "value": round(decisions, 1),
+        "unit": "decisions/s/chip",
+        "vs_baseline": None,
+        "p50_ms": round(float(p50), 3),
+        "p99_ms": round(float(p99), 3),
+        "latency_samples": len(lat_ms),
+        "request_sizes": sizes,
+        "buckets": fe.buckets,
+        "n_agents": a,
+        "dtype": args.serve_dtype,
+        "backend": jax.default_backend(),
+        "artifact": args.artifact,
+        "checkpoint_t_env": fe.meta.get("checkpoint", {}).get("t_env"),
+        "spans": _REC.summary(),
+    }))
+    return 0
+
+
 def bench_all(make_cfg, _time, _pipe_rate, args) -> int:
     """``--all``: the full single-chip measurement set in ONE process —
     one backend init total, for tunnel-scarce conditions (BASELINE.md
@@ -922,6 +1012,17 @@ def main() -> int:
                     help="PRNG impl for all keys: rbg = the TPU hardware "
                          "bit generator (cheaper for the rollout's many "
                          "small draws; different stream than threefry)")
+    ap.add_argument("--serve", action="store_true",
+                    help="measure the serving path: load an exported "
+                         "artifact (--artifact) through the batched "
+                         "front-end and report p50/p99 decision latency "
+                         "+ decisions/s/chip (docs/SERVING.md)")
+    ap.add_argument("--artifact", default=None, metavar="DIR",
+                    help="--serve: the exported serving artifact "
+                         "(python -m t2omca_tpu.serve export)")
+    ap.add_argument("--serve-dtype", choices=("float32", "bfloat16"),
+                    default="float32",
+                    help="--serve: which param variant to serve")
     ap.add_argument("--superstep", type=int, default=None, metavar="K",
                     help="measure the fused training superstep: ONE "
                          "program scanning K rollout->insert->train "
@@ -937,6 +1038,21 @@ def main() -> int:
                          "defaults to K=4 on full-scale runs, pass 0 "
                          "to disable")
     args = ap.parse_args()
+    if args.serve:
+        if args.artifact is None:
+            ap.error("--serve needs --artifact DIR (an exported serving "
+                     "artifact; python -m t2omca_tpu.serve export)")
+        if (args.all or args.hbm or args.prod_hbm or args.breakdown
+                or args.train or args.superstep is not None
+                or args.config != 3):
+            ap.error("--serve measures the exported artifact's serving "
+                     "path; drop --all/--hbm/--prod-hbm/--breakdown/"
+                     "--train/--superstep/--config")
+        if args.pipeline:
+            ap.error("--serve has its own hidden-carried throughput "
+                     "leg; drop --pipeline")
+    elif args.artifact is not None:
+        ap.error("--artifact only applies to --serve")
     if args.superstep is not None:
         if args.superstep < 1:
             ap.error("--superstep K must be >= 1")
@@ -962,7 +1078,7 @@ def main() -> int:
         # steady-state rate; --pipeline 0 disables. Smoke stays off (the
         # CPU contract tests pin the minimal schema).
         measures_chain = not (args.smoke or args.hbm or args.breakdown
-                              or args.prod_hbm
+                              or args.prod_hbm or args.serve
                               or args.superstep is not None)
         args.pipeline = 4 if measures_chain else 0
 
@@ -978,7 +1094,9 @@ def main() -> int:
     if not args.smoke and not args.hbm:
         # probe the backend FIRST, bounded in a subprocess (probe_backend):
         # the parseable error record must land BEFORE any caller timeout.
-        metric, unit = (("train_steps_per_sec", "train-steps/s/chip")
+        metric, unit = (("serve_decisions_per_sec", "decisions/s/chip")
+                        if args.serve
+                        else ("train_steps_per_sec", "train-steps/s/chip")
                         if args.train
                         else ("env_steps_per_sec", "env-steps/s/chip"))
         probe_s = float(os.environ.get("T2OMCA_BACKEND_PROBE_TIMEOUT",
@@ -992,6 +1110,11 @@ def main() -> int:
                 "spans": _REC.summary(),
             }), flush=True)
             return 1
+
+    if args.serve:
+        # the serving leg needs no train config at all — everything
+        # (model, buckets, params) comes from the artifact's meta
+        return bench_serve(args)
 
     from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,
                                    TrainConfig, sanity_check)
@@ -1251,9 +1374,12 @@ def main_flight() -> int:
                         and str(ev.get("outcome", "")).startswith("error")):
                     phase = ev["phase"]
                     break
-        # match main()'s probe-failure record: a crashed --train run
-        # must not file its partial record under the rollout metric
-        metric, unit = (("train_steps_per_sec", "train-steps/s/chip")
+        # match main()'s probe-failure record: a crashed --train or
+        # --serve run must not file its partial record under the
+        # rollout metric
+        metric, unit = (("serve_decisions_per_sec", "decisions/s/chip")
+                        if "--serve" in sys.argv
+                        else ("train_steps_per_sec", "train-steps/s/chip")
                         if "--train" in sys.argv
                         else ("env_steps_per_sec", "env-steps/s/chip"))
         print(f"# bench failed in phase {phase or 'unknown'}: "

@@ -116,6 +116,9 @@ TRACE_SYMBOLS = {
     "insert": ("jit__insert", "PjitFunction(_insert)"),
     "train_iter": ("jit__train_iter", "PjitFunction(_train_iter)"),
     "superstep": ("jit__superstep", "PjitFunction(_superstep)"),
+    # serving process only (serve/frontend.py) — never present in a
+    # training trace, so attribution cannot double-count
+    "serve_step": ("jit__serve_step", "PjitFunction(_serve_step)"),
 }
 
 
@@ -161,16 +164,17 @@ def audit_context(rebuild: bool = False) -> AuditContext:
 
 def collect_default_programs() -> Registry:
     """Gather every registered program from the component hooks, in a
-    stable order (run.py's driver programs, then the data-parallel and
-    learner surfaces). Each module names its own programs — the
-    registry stays free of program-construction knowledge."""
+    stable order (run.py's driver programs, then the data-parallel,
+    learner and serving surfaces). Each module names its own programs —
+    the registry stays free of program-construction knowledge."""
     from .. import run as run_mod
     from ..learners import qmix_learner as learner_mod
     from ..parallel import mesh as mesh_mod
+    from ..serve import program as serve_mod
 
     reg: Registry = {}
     ctx = audit_context()
-    for mod in (run_mod, mesh_mod, learner_mod):
+    for mod in (run_mod, mesh_mod, learner_mod, serve_mod):
         hook = getattr(mod, "register_audit_programs", None)
         if hook is None:
             continue

@@ -568,6 +568,15 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
     return cfg.replace(**updates)
 
 
+def from_dict(data: dict) -> TrainConfig:
+    """Rebuild a TrainConfig from its ``dataclasses.asdict`` form (the
+    serving artifact's ``meta.json`` round trip, serve/export.py) —
+    defaults → nested dict → the same sanity pass as every other
+    construction path, so a config that trained is a config that
+    loads."""
+    return sanity_check(_merge_nested(TrainConfig(), dict(data)))
+
+
 def _coerce(s: str) -> Any:
     if s.lower() in ("true", "false"):
         return s.lower() == "true"

@@ -45,6 +45,9 @@ PHASE_PROGRAMS = {
     "dispatch.rollout": "rollout",
     "dispatch.train": "train_iter",
     "dispatch.test": "rollout",
+    # serving runs (serve/frontend.py): the dispatch span joins the
+    # serve program's graftprog budgets on its own row
+    "serve.dispatch": "serve_step",
 }
 
 

@@ -81,6 +81,12 @@ KNOWN_PHASES = frozenset({
     # bench.py phases (bench harness spans; embedded in BENCH_r*.json)
     "bench.probe", "bench.build", "bench.compile", "bench.warm",
     "bench.measure",
+    # graftserve boundaries (serve/export.py, serve/frontend.py): the
+    # exporter's lower/compile/export pass, artifact load, and the
+    # three per-request front-end stages — `obs report` reads a
+    # serving run's spans.jsonl exactly like a training run's
+    "serve.export", "serve.load", "serve.pad", "serve.dispatch",
+    "serve.unpad",
 })
 
 _NOOP = contextlib.nullcontext()

@@ -40,7 +40,8 @@ from .runners.parallel_runner import ParallelRunner, RunnerState
 from .obs import spans as obs_spans
 from .utils import resilience, watchdog
 from .utils.checkpoint import (find_checkpoint, load_checkpoint,
-                               prune_checkpoints, save_checkpoint)
+                               load_checkpoint_sharded, prune_checkpoints,
+                               save_checkpoint)
 from .utils.logging import Logger
 from .utils.profiling import StageTimer, TraceWindow
 from .utils.stats import StatsAccumulator
@@ -477,6 +478,15 @@ def run_sequential(exp: Experiment, logger: Logger,
         # the single-device-then-reshard path holds a full extra copy of
         # the replay ring at startup, an OOM at config-5 ring sizes
         ts = dp.init_sharded(cfg.seed)
+    elif dp is not None:
+        # DP resume: restore each leaf straight onto the mesh
+        # (load_checkpoint_sharded) — the classic init → load → shard
+        # sequence re-creates the same single-device ring transient the
+        # born-sharded init exists to avoid (ADVICE r5)
+        shapes = jax.eval_shape(lambda: exp.init_train_state(cfg.seed))
+        ts = load_checkpoint_sharded(found[0], shapes,
+                                     dp.state_shardings(shapes),
+                                     verify=False)
     else:
         ts = exp.init_train_state(cfg.seed)
     # the driver loop replaces its state right after every call, so the
@@ -500,17 +510,19 @@ def run_sequential(exp: Experiment, logger: Logger,
     # ---- resume (reference :159-189, Q13: t_env cursor restored) ----
     if found is not None:
         dirname, step = found
-        # find_checkpoint already hashed this candidate — skip re-verify
-        ts = load_checkpoint(dirname, ts, verify=False)
+        if dp is None:
+            # find_checkpoint already hashed this candidate — skip
+            # re-verify (the DP path restored sharded above)
+            ts = load_checkpoint(dirname, ts, verify=False)
         t_env = step
-        ts = ts.replace(runner=ts.runner.replace(
-            t_env=jnp.asarray(step, jnp.int32)))
+        new_t = jnp.asarray(step, jnp.int32)
+        if dp is not None:
+            # keep the canonical replicated placement — a fresh
+            # single-device scalar here would hand the first dispatch a
+            # different input aval than every later iteration
+            new_t = jax.device_put(new_t, ts.runner.t_env.sharding)
+        ts = ts.replace(runner=ts.runner.replace(t_env=new_t))
         log.info(f"resumed from {dirname} at t_env={step}")
-    if dp is not None and found is not None:
-        # place the restored state on the mesh: params replicated, env
-        # lanes + replay episodes sharded on the data axis (fresh starts
-        # were born sharded above)
-        ts = dp.shard(ts)
 
     model_dir = os.path.join(cfg.local_results_path, "models",
                              os.path.basename(results_dir))
@@ -751,11 +763,22 @@ def run_sequential(exp: Experiment, logger: Logger,
         nonlocal ts, t_env, episode, buffer_filled, train_infos
         nonlocal last_test_t, last_log_t, last_runner_log_t, last_save_t
         nonlocal nonfinite_streak, train_acc
-        ts = load_checkpoint(dirname, ts, verify=False)
-        ts = ts.replace(runner=ts.runner.replace(
-            t_env=jnp.asarray(step, jnp.int32)))
         if dp is not None:
-            ts = dp.shard(ts)
+            # same born-sharded restore as the resume path: the live ts
+            # only contributes shape metadata (its donated leaves may
+            # already be deleted), and the single-device load → shard
+            # sequence would re-create the ring OOM mid-run (ADVICE r5)
+            shapes = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), ts)
+            ts = load_checkpoint_sharded(dirname, shapes,
+                                         dp.state_shardings(shapes),
+                                         verify=False)
+            new_t = jax.device_put(jnp.asarray(step, jnp.int32),
+                                   ts.runner.t_env.sharding)
+        else:
+            ts = load_checkpoint(dirname, ts, verify=False)
+            new_t = jnp.asarray(step, jnp.int32)
+        ts = ts.replace(runner=ts.runner.replace(t_env=new_t))
         # re-sync every host-side mirror of device state
         t_env = step
         episode = int(jax.device_get(ts.episode))

@@ -1,0 +1,33 @@
+"""graftserve — AOT-compiled policy serving (ROADMAP open item 5).
+
+The first user-facing subsystem: a frozen-params greedy
+``select_actions`` step exported ahead of traffic and fed by a
+host-side batcher.
+
+* ``serve/program.py`` — the ONE serving program definition (greedy
+  step + request-surface avals + the graftprog registry hook).
+* ``serve/export.py`` — ``python -m t2omca_tpu.serve export``: turn a
+  training checkpoint into a self-contained artifact (stripped +
+  pre-folded params in f32/bf16, per-bucket ``jax.export`` programs, a
+  warm persistent compile cache, provenance meta).
+* ``serve/frontend.py`` — the batched front-end: ragged request
+  batches pad/bucket into the compiled shapes, with per-request hidden
+  carry and full span telemetry.
+
+Gated by the same static machinery as training: the serve step is
+ratcheted in ``analysis/programs.json`` (FLOPs/bytes/fingerprint), the
+span phases are pinned by GL110, and ``bench.py --serve`` measures
+p50/p99 decision latency + decisions/s/chip. docs/SERVING.md is the
+contract.
+"""
+
+from .export import (ARTIFACT_FORMAT, DEFAULT_BUCKETS, export_artifact,
+                     load_acting_params)
+from .frontend import ServeFrontend, SessionStore, pad_request, pick_bucket
+from .program import build_serve_step, serve_avals
+
+__all__ = [
+    "ARTIFACT_FORMAT", "DEFAULT_BUCKETS", "ServeFrontend", "SessionStore",
+    "build_serve_step", "export_artifact", "load_acting_params",
+    "pad_request", "pick_bucket", "serve_avals",
+]

@@ -346,36 +346,42 @@ def prune_checkpoints(path: str, keep_last: int = 5,
     return removed
 
 
-def load_checkpoint(dirname: str, target: Any, verify: bool = True) -> Any:
-    """Restore into a template pytree of the same structure. The
-    ``meta.json`` sidecar (when present) turns a replay-layout mismatch
-    into a precise config instruction before any deserialization, and its
-    checksum (when present) turns silent corruption into
-    :class:`CheckpointIntegrityError` before flax sees a single byte.
-    Callers that just selected ``dirname`` via :func:`find_checkpoint`
-    already paid the SHA-256 pass there and may set ``verify=False`` to
-    skip re-hashing (one full read of a multi-GiB ring is real time)."""
+def _read_meta(dirname: str) -> Optional[dict]:
+    """The ``meta.json`` sidecar (None when absent) + format check."""
     meta_path = os.path.join(dirname, "meta.json")
-    meta = None
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
-        fmt = meta.get("format", 0)
-        if fmt > FORMAT_VERSION:
-            raise CheckpointFormatError(
-                f"checkpoint {dirname} has format v{fmt}, newer than this "
-                f"build's v{FORMAT_VERSION} — upgrade the framework to "
-                f"restore it")
-        saved = meta.get("obs_layout")
-        configured = _obs_layout(target)
-        if saved and configured and saved != configured:
-            want = "true" if saved == "compact" else "false"
-            raise ValueError(
-                f"checkpoint {dirname} stores the replay ring with "
-                f"'{saved}' obs layout but the config builds '{configured}' "
-                f"storage — set replay.compact_entity_store={want} (and for "
-                f"'compact' keep env_args.fast_norm=true) to resume this "
-                f"checkpoint (docs/SPEC.md perf modes)")
+    if not os.path.exists(meta_path):
+        return None
+    with open(meta_path) as f:
+        meta = json.load(f)
+    fmt = meta.get("format", 0)
+    if fmt > FORMAT_VERSION:
+        raise CheckpointFormatError(
+            f"checkpoint {dirname} has format v{fmt}, newer than this "
+            f"build's v{FORMAT_VERSION} — upgrade the framework to "
+            f"restore it")
+    return meta
+
+
+def restore_host_state(dirname: str, verify: bool = True,
+                       layout_target: Any = None
+                       ) -> Tuple[Optional[dict], Any]:
+    """Read one checkpoint to HOST memory: → ``(meta, raw_state_dict)``.
+
+    The shared bottom half of every restore path — format/integrity
+    checks plus one ``msgpack_restore`` into plain numpy leaves, with
+    **no device allocation**. :func:`load_checkpoint` builds the
+    classic single-placement restore on top; :func:`load_checkpoint_sharded`
+    places each leaf straight onto a mesh (the DP resume path); the
+    serve exporter (``serve/export.py``) picks the learner subtree out
+    of ``raw`` and never touches the ring. ``verify=False`` skips the
+    SHA-256 re-hash for callers that just paid it in
+    :func:`find_checkpoint`. ``layout_target`` (a TrainState-like tree,
+    concrete or eval_shape) opts into the replay obs-layout check
+    BEFORE the multi-GiB state read — a layout mismatch then costs a
+    meta.json read, not a full decode."""
+    meta = _read_meta(dirname)
+    if layout_target is not None:
+        _check_obs_layout(meta, layout_target, dirname)
     with open(os.path.join(dirname, "state.msgpack"), "rb") as f:
         data = f.read()
     if verify and meta is not None and meta.get("sha256") is not None:
@@ -388,37 +394,55 @@ def load_checkpoint(dirname: str, target: Any, verify: bool = True) -> Any:
                 f"corrupted; resume from an older step "
                 f"(find_checkpoint skips invalid checkpoints "
                 f"automatically)")
-    try:
-        if meta is None or meta.get("format", 0) < 3:
-            # v2 → v3 migration: v3 added RunnerState.rscale. No v2 run
-            # could have had reward_scaling on (the field did not exist),
-            # so injecting the template's fresh (all-zero) reward-scale
-            # state-dict is lossless — replay contents, normalizer stats,
-            # and RNG state all restore exactly. Meta-less checkpoints
-            # (pre-v2, before the sidecar existed — or a deleted sidecar)
-            # take the same path: injection is conditional on the field
-            # actually being absent, so a v3 tree without its meta.json
-            # still restores unmodified.
-            raw = serialization.msgpack_restore(data)
-            if (isinstance(raw, dict) and "runner" in raw
-                    and "rscale" not in raw["runner"]):
-                raw["runner"]["rscale"] = serialization.to_state_dict(
-                    jax.device_get(target.runner.rscale))
-            restored = serialization.from_state_dict(target, raw)
-        else:
-            restored = serialization.from_bytes(target, data)
-    except (KeyError, ValueError) as e:
+    return meta, serialization.msgpack_restore(data)
+
+
+def _check_obs_layout(meta: Optional[dict], target: Any,
+                      dirname: str) -> None:
+    """Replay-layout mismatch → a precise config instruction before any
+    deserialization (works on concrete AND eval_shape templates)."""
+    saved = meta.get("obs_layout") if meta else None
+    configured = _obs_layout(target)
+    if saved and configured and saved != configured:
+        want = "true" if saved == "compact" else "false"
         raise ValueError(
-            f"checkpoint {dirname} does not match the configured train-state "
-            f"structure: {e}. A common cause is the replay storage layout — "
-            f"checkpoints written before/after the compact entity storage "
-            f"default need replay.compact_entity_store toggled to match "
-            f"(docs/SPEC.md perf modes)") from e
-    # flax does not shape-validate on restore: a checkpoint from a
-    # different config (env lanes, replay capacity, DP shapes) would
-    # silently land wrong-shaped arrays that only explode later inside
-    # jit — reject it here so callers can fall back to the model-only
-    # restore (run.evaluate_sequential does)
+            f"checkpoint {dirname} stores the replay ring with "
+            f"'{saved}' obs layout but the config builds '{configured}' "
+            f"storage — set replay.compact_entity_store={want} (and for "
+            f"'compact' keep env_args.fast_norm=true) to resume this "
+            f"checkpoint (docs/SPEC.md perf modes)")
+
+
+def _migrate_raw(meta: Optional[dict], raw: Any, target: Any) -> Any:
+    """v2 → v3 migration: v3 added RunnerState.rscale. No v2 run could
+    have had reward_scaling on (the field did not exist), so injecting
+    the template's reward-scale state-dict is lossless — replay
+    contents, normalizer stats, and RNG state all restore exactly.
+    Meta-less checkpoints (pre-v2, or a deleted sidecar) take the same
+    path: injection is conditional on the field actually being absent,
+    so a v3 tree without its meta.json still restores unmodified.
+    Abstract template leaves (eval_shape restore) inject fresh zeros —
+    value-identical to a fresh RunnerState's rscale."""
+    if meta is not None and meta.get("format", 0) >= 3:
+        return raw
+    if (isinstance(raw, dict) and "runner" in raw
+            and "rscale" not in raw["runner"]):
+        import numpy as _np
+        host = jax.tree.map(
+            lambda x: (_np.zeros(x.shape, x.dtype)
+                       if isinstance(x, jax.ShapeDtypeStruct)
+                       else jax.device_get(x)),
+            target.runner.rscale)
+        raw["runner"]["rscale"] = serialization.to_state_dict(host)
+    return raw
+
+
+def _check_leaf_shapes(target: Any, restored: Any, dirname: str) -> None:
+    """flax does not shape-validate on restore: a checkpoint from a
+    different config (env lanes, replay capacity, DP shapes) would
+    silently land wrong-shaped arrays that only explode later inside
+    jit — reject it here so callers can fall back to the model-only
+    restore (run.evaluate_sequential does)."""
     t_leaves = jax.tree_util.tree_leaves_with_path(target)
     r_leaves = jax.tree_util.tree_leaves_with_path(restored)
     bad = [
@@ -433,7 +457,73 @@ def load_checkpoint(dirname: str, target: Any, verify: bool = True) -> Any:
             f"{len(bad)} leaves mismatch the template (first: {k} stored "
             f"{sr} vs configured {st}). Use load_learner_state for "
             f"model-only restore (reference semantics).")
+
+
+def _restore_into(dirname: str, target: Any, verify: bool) -> Any:
+    """Shared restore core: host read (obs-layout checked from the
+    sidecar BEFORE the state decode) → migration → structure match →
+    per-leaf shape validation. ``target`` may be concrete
+    (:func:`load_checkpoint`) or an eval_shape template
+    (:func:`load_checkpoint_sharded`) — either way the returned leaves
+    are the stored host numpy arrays."""
+    meta, raw = restore_host_state(dirname, verify=verify,
+                                   layout_target=target)
+    try:
+        restored = serialization.from_state_dict(
+            target, _migrate_raw(meta, raw, target))
+    except (KeyError, ValueError) as e:
+        raise ValueError(
+            f"checkpoint {dirname} does not match the configured train-state "
+            f"structure: {e}. A common cause is the replay storage layout — "
+            f"checkpoints written before/after the compact entity storage "
+            f"default need replay.compact_entity_store toggled to match "
+            f"(docs/SPEC.md perf modes)") from e
+    _check_leaf_shapes(target, restored, dirname)
     return restored
+
+
+def load_checkpoint(dirname: str, target: Any, verify: bool = True) -> Any:
+    """Restore into a template pytree of the same structure. The
+    ``meta.json`` sidecar (when present) turns a replay-layout mismatch
+    into a precise config instruction before the state blob is even
+    read, and its checksum (when present) turns silent corruption into
+    :class:`CheckpointIntegrityError` before flax sees a single byte.
+    Callers that just selected ``dirname`` via :func:`find_checkpoint`
+    already paid the SHA-256 pass there and may set ``verify=False`` to
+    skip re-hashing (one full read of a multi-GiB ring is real time)."""
+    return _restore_into(dirname, target, verify)
+
+
+def load_checkpoint_sharded(dirname: str, template: Any, shardings: Any,
+                            verify: bool = True) -> Any:
+    """Restore into an ABSTRACT template (a ``jax.eval_shape`` pytree),
+    placing each leaf directly under its sharding — the resume-side
+    analog of ``DataParallel.init_sharded`` (ADVICE r5): the classic
+    ``init → load_checkpoint → dp.shard`` sequence materializes the
+    full TrainState (notably the replay ring) on ONE device before the
+    mesh placement, which is an OOM at config-5 ring sizes. Here the
+    state exists host-side as numpy only, and each leaf is
+    ``device_put`` under its ``shardings`` entry one at a time — the
+    host copy of every placed leaf is dropped immediately, so peak
+    device memory is the sharded state plus one leaf, never 1 + 1/N
+    rings. ``template`` and ``shardings`` must be structure-identical
+    (``DataParallel.state_shardings(template)`` builds the latter)."""
+    restored = _restore_into(dirname, template, verify)
+    flat, treedef = jax.tree_util.tree_flatten(restored)
+    # the flat list is now the ONLY holder of the host leaves — without
+    # this, `restored` would pin every leaf and the per-leaf free below
+    # would free nothing
+    del restored
+    flat_sh = jax.tree_util.tree_flatten(shardings)[0]
+    if len(flat_sh) != len(flat):
+        raise ValueError(
+            f"shardings tree has {len(flat_sh)} leaves but the template "
+            f"has {len(flat)} — build it with state_shardings(template)")
+    placed = []
+    for i, sh in enumerate(flat_sh):
+        placed.append(jax.device_put(flat[i], sh))
+        flat[i] = None               # leaf streaming: free the host copy
+    return jax.tree_util.tree_unflatten(treedef, placed)
 
 
 def load_learner_state(dirname: str, target: Any) -> Any:
