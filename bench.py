@@ -444,6 +444,67 @@ def bench_dp(cfg, _time, args) -> int:
     return 0
 
 
+def bench_kernels(make_cfg_kernels, _time, args) -> int:
+    """``--kernels``: the attention-kernel A/B leg. One rollout
+    measurement per requested kernel mode (xla = einsum path, pallas =
+    fused flash kernel; ``ab`` = both, xla first), each as its own JSON
+    record with the mode in the record, so a kernel win is attributable
+    in ``obs report``'s roofline table instead of a bare before/after
+    number. Like ``--all``, each record embeds the CUMULATIVE span
+    summary (a wedge in leg 2 still leaves leg 1's phase timings on
+    record); the per-mode split lives in the span STREAM via the
+    ``leg=kernels-<mode>`` meta on every span.
+
+    The leg forces the DENSE acting path: MultiHeadAttention — the
+    program the kernel switch selects — is what the dense rollout scan
+    dispatches; the qslice/entity fast paths bypass it by construction,
+    so an A/B over them would measure nothing."""
+    import jax
+
+    from t2omca_tpu.run import Experiment
+
+    modes = ("xla", "pallas") if args.kernels == "ab" else (args.kernels,)
+    rc = 0
+    for mode in modes:
+        cfg = make_cfg_kernels(mode)
+        label = f"kernels-{mode}"
+        with _REC.span("bench.build", leg=label):
+            exp = Experiment.build(cfg)
+            ts = exp.init_train_state(0)
+        rollout = jax.jit(exp.runner.run, static_argnames="test_mode")
+        params = ts.learner.params["agent"]
+        with _REC.span("bench.compile", leg=label):
+            rs, batch, _ = rollout(params, ts.runner, test_mode=False)
+            _sync(batch.reward[0, 0])
+
+        def one(rollout=rollout, params=params, rs=rs):
+            _, b, _ = rollout(params, rs, test_mode=False)
+            return b.reward[0, 0]
+
+        with _REC.span("bench.measure", leg=label):
+            dt = _time(one)
+        env_steps = cfg.batch_size_run * cfg.env_args.episode_limit
+        rate = env_steps / dt
+        print(f"# kernels={mode}: {dt * 1e3:.1f} ms for {env_steps} "
+              f"env-steps (dense acting, "
+              f"{cfg.env_args.agv_num} AGVs, d{cfg.model.emb})",
+              file=sys.stderr)
+        print(json.dumps({
+            "metric": "env_steps_per_sec",
+            "value": round(rate, 1),
+            "unit": "env-steps/s/chip",
+            "vs_baseline": round(rate / 50_000.0, 3),
+            "kernels": mode,
+            "acting": "dense",
+            "config": (None if args.smoke or args.envs or args.steps
+                       else args.config),
+            "n_envs": cfg.batch_size_run,
+            "episode_steps": cfg.env_args.episode_limit,
+            "spans": _REC.summary(),
+        }), flush=True)
+    return rc
+
+
 def bench_superstep(cfg, _time, args) -> int:
     """``--superstep K``: the dispatch-amortized training rate. ONE fused
     XLA program scans K rollout → in-place ring insert → (gated)
@@ -1023,6 +1084,15 @@ def main() -> int:
     ap.add_argument("--serve-dtype", choices=("float32", "bfloat16"),
                     default="float32",
                     help="--serve: which param variant to serve")
+    ap.add_argument("--kernels", choices=("xla", "pallas", "ab"),
+                    default=None,
+                    help="attention-kernel A/B leg: measure the DENSE "
+                         "rollout under the selected kernels.attention "
+                         "mode (xla = einsum path, pallas = fused flash "
+                         "kernel; ab = both) — one JSON record per mode "
+                         "with the mode in the record (spans summary is "
+                         "cumulative across legs, like --all; per-mode "
+                         "split via each span's leg= meta)")
     ap.add_argument("--superstep", type=int, default=None, metavar="K",
                     help="measure the fused training superstep: ONE "
                          "program scanning K rollout->insert->train "
@@ -1053,6 +1123,13 @@ def main() -> int:
                      "leg; drop --pipeline")
     elif args.artifact is not None:
         ap.error("--artifact only applies to --serve")
+    if args.kernels is not None:
+        if (args.all or args.hbm or args.prod_hbm or args.breakdown
+                or args.train or args.serve or args.superstep is not None
+                or args.config == 5):
+            ap.error("--kernels measures the dense rollout under each "
+                     "attention-kernel mode; drop --all/--hbm/--prod-hbm/"
+                     "--breakdown/--train/--serve/--superstep/--config 5")
     if args.superstep is not None:
         if args.superstep < 1:
             ap.error("--superstep K must be >= 1")
@@ -1079,7 +1156,8 @@ def main() -> int:
         # CPU contract tests pin the minimal schema).
         measures_chain = not (args.smoke or args.hbm or args.breakdown
                               or args.prod_hbm or args.serve
-                              or args.superstep is not None)
+                              or args.superstep is not None
+                              or args.kernels is not None)
         args.pipeline = 4 if measures_chain else 0
 
     if args.smoke or args.hbm:
@@ -1108,7 +1186,11 @@ def main() -> int:
                 "metric": metric, "value": None,
                 "unit": unit, "vs_baseline": None, **failure,
                 "spans": _REC.summary(),
-            }), flush=True)
+                # the flight tail rides along like main_flight's partial
+                # record: a wedged-tunnel probe failure then shows its
+                # phase history (BENCH_r03–r05 left only a bare error)
+                "spans_tail": _REC.tail()[-20:],
+            }, default=repr), flush=True)
             return 1
 
     if args.serve:
@@ -1197,6 +1279,22 @@ def main() -> int:
             jax.profiler.stop_trace()
             print(f"# trace written to {args.profile}", file=sys.stderr,
                   flush=True)
+
+    if args.kernels is not None:
+        import dataclasses as _dc
+
+        from t2omca_tpu.config import KernelsConfig
+
+        def make_cfg_kernels(mode: str):
+            # dense acting: the kernel switch selects the program the
+            # dense rollout dispatches (bench_kernels docstring)
+            base = (cfg.replace(model=_dc.replace(cfg.model,
+                                                  use_qslice=False))
+                    if args.smoke else make_cfg("dense", args.config))
+            return base.replace(kernels=KernelsConfig(attention=mode))
+
+        with tracing():
+            return bench_kernels(make_cfg_kernels, _time, args)
 
     if args.superstep is not None:
         with tracing():

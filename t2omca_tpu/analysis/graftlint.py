@@ -105,6 +105,9 @@ HOT_PATH_GLOBS: Tuple[str, ...] = (
     "t2omca_tpu/components/episode_buffer.py",
     "t2omca_tpu/components/host_replay.py",
     "t2omca_tpu/runners/*.py",
+    # the kernel layer IS the hot path: a device_get/block_until_ready
+    # creeping into a kernel wrapper would stall every rollout scan step
+    "t2omca_tpu/kernels/*.py",
 )
 
 # tracing entry points: wrapping one of these around a function makes its

@@ -123,7 +123,16 @@ class ProgramReport:
 
 def _iter_closed_jaxprs(closed) -> Iterator[object]:
     """Yield ``closed`` and every ClosedJaxpr nested in equation params
-    (pjit bodies, scan/cond/while branches, custom_* rules), each once."""
+    (pjit bodies, scan/cond/while branches, custom_* rules), each once.
+
+    ``pallas_call`` equations are NOT descended into: their params hold
+    the kernel jaxpr plus block-spec/index-map machinery (grid mapping,
+    closed-over tile constants) that describes device-kernel plumbing,
+    not host-side program structure — walking it would misreport the
+    kernel's internal f32 accumulator casts as GP203 churn and its
+    block-spec tables as GP202 baked constants. A Pallas kernel is
+    audited as one opaque device op, like any other XLA custom call;
+    pinned by tests/test_graftprog.py."""
     from jax.core import ClosedJaxpr
     seen = set()
     stack = [closed]
@@ -134,6 +143,8 @@ def _iter_closed_jaxprs(closed) -> Iterator[object]:
         seen.add(id(cj))
         yield cj
         for eqn in cj.jaxpr.eqns:
+            if "pallas" in eqn.primitive.name:
+                continue                 # opaque device kernel (above)
             for v in eqn.params.values():
                 if isinstance(v, ClosedJaxpr):
                     stack.append(v)
@@ -185,11 +196,18 @@ def _upcast_findings(closed, compute_dtype: str) -> List[str]:
 
 
 def _callback_findings(closed) -> List[str]:
-    """GP204: host-callback primitives anywhere in the program."""
+    """GP204: host-callback primitives anywhere in the program.
+    ``pallas_call`` is explicitly exempt: it is a device kernel launch
+    (Mosaic custom call on TPU, interpreter evaluation on CPU), not a
+    host round-trip — name-matching must never misclassify it even if a
+    future jax release renames the primitive toward the callback
+    family."""
     out = []
     for cj in _iter_closed_jaxprs(closed):
         for eqn in cj.jaxpr.eqns:
             name = eqn.primitive.name
+            if "pallas" in name:
+                continue                 # device kernel, not a callback
             if "callback" in name:
                 out.append(f"`{name}` inside the program: every dispatch "
                            f"blocks on a host round-trip")

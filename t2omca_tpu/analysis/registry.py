@@ -119,6 +119,18 @@ TRACE_SYMBOLS = {
     # serving process only (serve/frontend.py) — never present in a
     # training trace, so attribution cannot double-count
     "serve_step": ("jit__serve_step", "PjitFunction(_serve_step)"),
+    # attention kernel modes (kernels/attention.py). The jit symbols
+    # appear only in standalone kernel dispatches (bench --kernels A/B,
+    # the audit programs); inside a rollout/superstep trace the pallas
+    # kernel instead shows up as its Mosaic kernel launch, whose name
+    # carries the kernel function — listed so fused-kernel device time
+    # is attributed instead of silently falling into the unattributed
+    # bucket. The einsum mode has no distinct device symbol when fused
+    # (XLA melts it into the surrounding fusion), so attn_xla only
+    # attributes standalone dispatches.
+    "attn_xla": ("jit__attn_xla", "PjitFunction(_attn_xla)"),
+    "attn_pallas": ("jit__attn_pallas", "PjitFunction(_attn_pallas)",
+                    "flash_attention_kernel"),
 }
 
 
@@ -168,13 +180,14 @@ def collect_default_programs() -> Registry:
     learner and serving surfaces). Each module names its own programs —
     the registry stays free of program-construction knowledge."""
     from .. import run as run_mod
+    from ..kernels import attention as kernels_mod
     from ..learners import qmix_learner as learner_mod
     from ..parallel import mesh as mesh_mod
     from ..serve import program as serve_mod
 
     reg: Registry = {}
     ctx = audit_context()
-    for mod in (run_mod, mesh_mod, learner_mod, serve_mod):
+    for mod in (run_mod, mesh_mod, learner_mod, serve_mod, kernels_mod):
         hook = getattr(mod, "register_audit_programs", None)
         if hook is None:
             continue

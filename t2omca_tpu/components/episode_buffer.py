@@ -241,26 +241,40 @@ class ReplayBuffer:
     def insert_time_major(self, state: BufferState,
                           tm: TimeMajorEpisodes) -> BufferState:
         """Ring-insert straight from the rollout scan's time-major
-        emission: two scatters per (T+1)-length leaf (steps 0..T-1 from
-        the stacked scan output, step T from the bootstrap step) instead
-        of concatenate-into-an-episode-batch-then-copy. Contents are
-        bit-identical to ``insert_episode_batch(state, tm.to_batch())``
-        — the fused superstep relies on that for K=1 parity — but the
-        ``(B, T+1, ...)`` intermediate never exists, which matters inside
-        the donated superstep program where the ring is updated in
-        place."""
+        emission: ONE scatter per leaf via a combined ``(slot, t)``
+        index map. The former path did two scatters per (T+1)-length
+        leaf (steps 0..T-1 from the scan stack, step T from the
+        bootstrap) and paid a ``(T, B, ...) -> (B, T, ...)`` transpose
+        of every stacked leaf to line the updates up with the ring
+        layout. Here the updates stay TIME-MAJOR — the scan stack and
+        the bootstrap step concatenate along the existing time axis
+        (no transpose, and XLA fuses the concat into the scatter's
+        update operand) — and a 2-D index grid scatters row ``(t, b)``
+        straight to ring element ``(slots[b], t)`` in one writeback.
+        The eliminated transpose + second scatter pass are the insert
+        bytes the GP302 ratchet pins DOWN on the compiled superstep
+        program. Contents are bit-identical to
+        ``insert_episode_batch(state, tm.to_batch())`` — the fused
+        superstep relies on that for K=1 parity."""
         b = tm.batch_size
         idx = self._ring_slots(state, b)
+        t1 = self.episode_limit + 1
+        # combined index map shared by every (T+1)-leaf scatter: update
+        # row (t, b) lands at ring element (slots[b], t)
+        t_grid = jnp.broadcast_to(jnp.arange(t1)[:, None], (t1, b))
+        s_grid = jnp.broadcast_to(idx[None, :], (t1, b))
 
         def put_tp1(s, seq, last):
-            """(cap, T+1, ...) leaf ← (T, B, ...) scan stack + (B, ...)."""
-            s = s.at[idx, :-1].set(
-                jnp.swapaxes(seq, 0, 1).astype(s.dtype))
-            return s.at[idx, -1].set(last.astype(s.dtype))
+            """(cap, T+1, ...) leaf ← one scatter of the time-major
+            (T+1, B, ...) updates (scan stack ++ bootstrap step)."""
+            upd = jnp.concatenate([seq, last[None]], axis=0)
+            return s.at[s_grid, t_grid].set(upd.astype(s.dtype))
 
         def put_t(s, seq):
-            """(cap, T, ...) leaf ← (T, B, ...) scan stack."""
-            return s.at[idx].set(jnp.swapaxes(seq, 0, 1).astype(s.dtype))
+            """(cap, T, ...) leaf ← one scatter of the time-major
+            (T, B, ...) scan stack (same combined index map, first T
+            rows — no transpose here either)."""
+            return s.at[s_grid[:-1], t_grid[:-1]].set(seq.astype(s.dtype))
 
         st = state.storage
         storage = st.replace(

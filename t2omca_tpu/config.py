@@ -112,6 +112,18 @@ class ModelConfig:
     # entity counts: filled from env info when 0
     n_entities_obs: int = 0
     n_entities_state: int = 0
+    # acting-path compute dtype (docs/PERF.md dtype policy): the dtype
+    # select_actions/rollout forwards run in, threaded the same way
+    # replay.store_dtype is. "" (default) inherits model.dtype — every
+    # existing config is byte-identical. "bfloat16" over a float32
+    # model.dtype is the bf16-acting mode: the per-rollout acting fold
+    # (BasicMAC.prepare_acting_params) casts params once per rollout
+    # and the scan-step forwards compute in bf16, while softmax
+    # statistics, LayerNorm statistics, the carried hidden token, the
+    # q-head output and the env normalizer all stay f32, and the TRAIN
+    # path keeps model.dtype untouched (f32 parity configs stay
+    # bit-identical between acting and learner unroll).
+    act_dtype: str = ""
 
 
 @dataclass(frozen=True)
@@ -229,6 +241,24 @@ class ObsConfig:
     # JSONL sink is the durable record. print_recent_stats only reads
     # the last 5 entries, so any cap >= 5 is observationally identical.
     stats_history: int = 1024
+
+
+@dataclass(frozen=True)
+class KernelsConfig:
+    """Rollout hot-path kernel selection (``t2omca_tpu/kernels/``,
+    docs/PERF.md). Every entry keeps the XLA lowering as the default
+    with CPU-gate parity tests pinning the hand-written kernel against
+    it, so flipping a switch is a performance decision, never a
+    semantics one."""
+
+    # attention kernel for MultiHeadAttention (per-agent transformer AND
+    # the mixer): "xla" = the einsum→softmax→einsum path (materializes
+    # the (B·A, H, Q, K) logits tensor every env step); "pallas" = the
+    # fused flash-style kernel (kernels/attention.py — tiled QK^T →
+    # masked online softmax → PV, f32 accumulators, logits live only in
+    # VMEM). Off-TPU the pallas kernel runs in interpreter mode, which
+    # is what keeps it inside the CPU tier-1 gate.
+    attention: str = "xla"
 
 
 @dataclass(frozen=True)
@@ -360,6 +390,7 @@ class TrainConfig:
     replay: ReplayConfig = field(default_factory=ReplayConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    kernels: KernelsConfig = field(default_factory=KernelsConfig)
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
@@ -494,6 +525,13 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
             "contradictory (same dead-knob policy as "
             "first_dispatch_timeout without dispatch_timeout); set "
             "obs.enabled=true too")
+    if cfg.kernels.attention not in ("xla", "pallas"):
+        raise ValueError(f"kernels.attention must be xla/pallas, got "
+                         f"{cfg.kernels.attention!r}")
+    if cfg.model.act_dtype not in ("", "float32", "bfloat16"):
+        raise ValueError(
+            f"model.act_dtype must be ''/float32/bfloat16 ('' inherits "
+            f"model.dtype), got {cfg.model.act_dtype!r}")
     if cfg.mixer == "transformer" and cfg.model.mixer_emb != cfg.model.emb:
         raise ValueError(
             "mixer_emb must equal emb: the transformer mixer concatenates "
@@ -524,6 +562,7 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
     replay_kw = dict(updates.pop("replay", {}) or {})
     resilience_kw = dict(updates.pop("resilience", {}) or {})
     obs_kw = dict(updates.pop("obs", {}) or {})
+    kernels_kw = dict(updates.pop("kernels", {}) or {})
 
     # route flat keys to their sub-config for reference-style flat configs
     env_fields = {f.name for f in dataclasses.fields(EnvConfig)}
@@ -531,6 +570,7 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
     replay_fields = {f.name for f in dataclasses.fields(ReplayConfig)}
     resilience_fields = {f.name for f in dataclasses.fields(ResilienceConfig)}
     obs_fields = {f.name for f in dataclasses.fields(ObsConfig)}
+    kernels_fields = {f.name for f in dataclasses.fields(KernelsConfig)}
     top_fields = {f.name for f in dataclasses.fields(TrainConfig)}
     flat = dict(updates)
     for k, v in flat.items():
@@ -551,6 +591,9 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
         elif k in obs_fields:
             obs_kw.setdefault(k, v)
             updates.pop(k)
+        elif k in kernels_fields:
+            kernels_kw.setdefault(k, v)
+            updates.pop(k)
         else:
             raise KeyError(f"unknown config key: {k}")
 
@@ -565,6 +608,8 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
                                                     **resilience_kw)
     if obs_kw:
         updates["obs"] = dataclasses.replace(cfg.obs, **obs_kw)
+    if kernels_kw:
+        updates["kernels"] = dataclasses.replace(cfg.kernels, **kernels_kw)
     return cfg.replace(**updates)
 
 

@@ -40,6 +40,16 @@ class BasicMAC:
     emb: int
     use_qslice: bool = False    # exact token-0-only forward (ops/query_slice)
     use_entity_tables: bool = False   # table-contracted entity acting
+    # acting-path compute dtype (model.act_dtype, docs/PERF.md): None =
+    # inherit the agent's (train) dtype — byte-identical to pre-act_dtype
+    # builds. When it differs, select_actions runs its forwards in this
+    # dtype over params pre-cast once per rollout
+    # (prepare_acting_params), while the learner unrolls keep the train
+    # dtype (acting=False default on the forwards below).
+    act_dtype: object = None
+    # dense-path module clone at act_dtype (None = share `agent`); the
+    # qslice/entity forwards take the dtype as an argument instead
+    act_agent: object = None
 
     @classmethod
     def build(cls, cfg: TrainConfig, env_info: dict) -> "BasicMAC":
@@ -64,6 +74,7 @@ class BasicMAC:
             standard_heads=cfg.model.standard_heads,
             use_orthogonal=cfg.model.use_orthogonal,
             dtype=jnp.dtype(cfg.model.dtype),
+            attn_impl=cfg.kernels.attention,
         )
         schedule = DecayThenFlatSchedule(
             cfg.epsilon_start, cfg.epsilon_finish, cfg.epsilon_anneal_time)
@@ -72,11 +83,17 @@ class BasicMAC:
         from ..ops.query_slice import (agent_qslice_eligible,
                                        entity_tables_eligible)
         use_qslice = agent_qslice_eligible(cfg)
+        act_dtype = jnp.dtype(cfg.model.act_dtype or cfg.model.dtype)
+        # param shapes are dtype-independent, so the acting clone applies
+        # the SAME param tree — only the activation casts differ
+        act_agent = (agent.clone(dtype=act_dtype)
+                     if act_dtype != agent.dtype else None)
         return cls(agent=agent, selector=selector, n_agents=n_agents,
                    n_actions=env_info["n_actions"], emb=cfg.model.emb,
                    use_qslice=use_qslice,
                    use_entity_tables=(use_qslice
-                                      and entity_tables_eligible(cfg)))
+                                      and entity_tables_eligible(cfg)),
+                   act_dtype=act_dtype, act_agent=act_agent)
 
     # ------------------------------------------------------------------ state
 
@@ -91,19 +108,31 @@ class BasicMAC:
 
     # ------------------------------------------------------------------ forward
 
+    @property
+    def _acting_dtype(self):
+        """Acting-path compute dtype (falls back to the train dtype for
+        MACs constructed directly in tests/legacy callers)."""
+        return (self.act_dtype if self.act_dtype is not None
+                else self.agent.dtype)
+
     def forward(self, params, obs: jnp.ndarray, hidden: jnp.ndarray,
-                key: jax.Array | None = None, deterministic: bool = True
+                key: jax.Array | None = None, deterministic: bool = True,
+                acting: bool = False
                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """obs ``(B, A, obs_dim)`` → (q ``(B, A, n_actions)``, hidden').
         ``key`` seeds NoisyLinear resampling and dropout when
-        ``deterministic`` is False."""
+        ``deterministic`` is False. ``acting=True`` (select_actions)
+        runs the act_dtype module clone; the learner unroll keeps the
+        default (train dtype)."""
         if key is not None:
             k_noise, k_drop = jax.random.split(key)
             rngs = {"noise": k_noise, "dropout": k_drop}
         else:
             rngs = None
-        return self.agent.apply(params, obs, hidden,
-                                deterministic=deterministic, rngs=rngs)
+        module = (self.act_agent if acting and self.act_agent is not None
+                  else self.agent)
+        return module.apply(params, obs, hidden,
+                            deterministic=deterministic, rngs=rngs)
 
     def _noise_key(self, key, deterministic: bool):
         """Noise key for the qslice/entity q-head: only noisy agents in
@@ -115,25 +144,30 @@ class BasicMAC:
 
     def forward_qslice(self, params, obs: jnp.ndarray, hidden: jnp.ndarray,
                        key: jax.Array | None = None,
-                       deterministic: bool = True
+                       deterministic: bool = True,
+                       acting: bool = False
                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Exact token-0-only forward over the same param tree
         (ops/query_slice). Plain jnp, differentiable — also used by the
         learner's deterministic AND noisy unrolls (the noise lives only in
         the q-head). ``params`` may be the raw tree or a
-        ``prepare_acting_params`` result."""
+        ``prepare_acting_params`` result; ``acting=True`` computes in the
+        act_dtype (and must be paired with the acting-dtype fold — the
+        folded tree short-circuits the per-call fold)."""
         from ..ops.query_slice import agent_forward_qslice
         a = self.agent
         return agent_forward_qslice(
             params, obs, hidden,
             n_entities=a.n_entities, feat_dim=a.feat_dim, emb=a.emb,
             heads=a.heads, depth=a.depth, n_actions=a.n_actions,
-            standard_heads=a.standard_heads, dtype=a.dtype,
+            standard_heads=a.standard_heads,
+            dtype=self._acting_dtype if acting else a.dtype,
             noise_key=self._noise_key(key, deterministic))
 
     def forward_entity(self, params, compact, hidden: jnp.ndarray,
                        key: jax.Array | None = None,
-                       deterministic: bool = True
+                       deterministic: bool = True,
+                       acting: bool = False
                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Entity-table forward (ops/query_slice): ``compact`` is the
         ``env.compact_obs`` tuple, batched over envs."""
@@ -143,22 +177,47 @@ class BasicMAC:
         return agent_forward_qslice_entity(
             params, rows, same_mec, mean, std, hidden,
             emb=a.emb, heads=a.heads, depth=a.depth, n_actions=a.n_actions,
-            standard_heads=a.standard_heads, dtype=a.dtype,
+            standard_heads=a.standard_heads,
+            dtype=self._acting_dtype if acting else a.dtype,
             noise_key=self._noise_key(key, deterministic))
 
-    def prepare_acting_params(self, params):
+    def prepare_acting_params(self, params, dtype=None):
         """Pre-fold the qslice projection products ONCE, outside any scan
         that calls ``select_actions``/``forward_qslice`` in its body (the
-        fold is loop-invariant; XLA is not guaranteed to hoist it). No-op
-        on the dense path."""
+        fold is loop-invariant; XLA is not guaranteed to hoist it). The
+        fold runs in the ACTING dtype; under the bf16-acting mode
+        (model.act_dtype over an f32 train dtype) the remaining float
+        leaves are pre-cast here too, so every scan step reads half the
+        param bytes instead of re-casting f32 storage per step. No-op
+        on the dense path with the default act_dtype.
+
+        ``dtype`` overrides the fold dtype (the serving exporter passes
+        the TRAIN dtype so the artifact's canonical f32 variant stays
+        act_dtype-free — serving's dtype story is the per-variant cast,
+        not the training run's rollout knob)."""
+        ad = jnp.dtype(dtype) if dtype is not None else self._acting_dtype
         if not self.use_qslice:
-            return params
+            return self._cast_acting(params, ad)
         from ..ops.query_slice import fold_agent_params
         a = self.agent
-        return fold_agent_params(params, emb=a.emb, heads=a.heads,
-                                 depth=a.depth,
-                                 standard_heads=a.standard_heads,
-                                 dtype=a.dtype)
+        folded = fold_agent_params(params, emb=a.emb, heads=a.heads,
+                                   depth=a.depth,
+                                   standard_heads=a.standard_heads,
+                                   dtype=ad)
+        return self._cast_acting(folded, ad)
+
+    def _cast_acting(self, tree, ad):
+        """Pre-cast f32 param leaves to the acting dtype — only in the
+        explicit mixed mode (act_dtype != train dtype), so every default
+        config keeps its exact pre-act_dtype numerics. LayerNorm/softmax
+        STATISTICS stay f32 regardless (computed in f32 inside the
+        forwards; docs/PERF.md dtype policy)."""
+        if ad == self.agent.dtype:
+            return tree
+        cast = lambda x: (x.astype(ad)
+                          if (hasattr(x, "dtype")
+                              and x.dtype == jnp.float32) else x)
+        return jax.tree.map(cast, tree)
 
     def select_actions(self, params, obs: jnp.ndarray, avail: jnp.ndarray,
                        hidden: jnp.ndarray, key: jax.Array,
@@ -173,14 +232,16 @@ class BasicMAC:
         if self.use_entity_tables and compact is not None:
             q, hidden = self.forward_entity(params, compact, hidden,
                                             key=k_noise,
-                                            deterministic=test_mode)
+                                            deterministic=test_mode,
+                                            acting=True)
         elif self.use_qslice:
             q, hidden = self.forward_qslice(params, obs, hidden,
                                             key=k_noise,
-                                            deterministic=test_mode)
+                                            deterministic=test_mode,
+                                            acting=True)
         else:
             q, hidden = self.forward(params, obs, hidden, key=k_noise,
-                                     deterministic=test_mode)
+                                     deterministic=test_mode, acting=True)
         actions, eps = self.selector.select(k_sel, q, avail, t_env,
                                             test_mode=test_mode)
         return actions.astype(jnp.int32), hidden, eps

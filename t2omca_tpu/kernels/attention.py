@@ -1,0 +1,304 @@
+"""Pallas flash-style fused entity-attention (ROADMAP item 1).
+
+The XLA einsum path (``models/transformer.MultiHeadAttention``)
+materializes the full ``(B·A, H, Q, K)`` logits tensor in HBM every env
+step — at the north-star scale (64 agents, 65 tokens, 1024 envs) that is
+the single largest write of the rollout slot, and Podracer/EnvPool
+(PAPERS.md) both identify exactly this class of per-step tensor traffic
+as what keeps a fused rollout memory-bandwidth-bound. This kernel runs
+the classic flash pattern instead: tiled ``QK^T`` → masked **online
+softmax** → ``PV`` accumulation, all inside one ``pallas_call`` whose
+logits tile lives only in VMEM — the ``(Q, K)`` tensor never exists in
+HBM.
+
+Numerics contract (pinned by ``tests/test_kernels.py``):
+
+* **f32 accumulators always** — the running max/denominator and the PV
+  accumulator are f32 regardless of the input dtype, so the bf16 path
+  here is *better*-conditioned than the einsum bf16 path (which
+  softmaxes in bf16). f32 inputs match the einsum path to float
+  reassociation (online vs max-subtracted softmax — same math,
+  different association; ULP-bounded in tests).
+* **Mask semantics mirror the module**: padding-mask positions are
+  *replaced* with ``NEG_MASK_VALUE`` (−1e9), not biased — so a
+  fully-masked row degrades to the same uniform distribution the
+  einsum path produces (an additive bias would silently cancel in the
+  softmax). Causal positions use the same finite value; ``exp``
+  underflows those contributions to exactly 0.0 in both paths.
+* **Differentiable everywhere**: the backward pass recomputes the
+  reference einsum attention and takes its VJP (a custom VJP — Pallas
+  primitives have no transpose rule), so the learner's dense unroll can
+  train straight through the kernel with gradients identical to the
+  einsum path evaluated at the same inputs.
+
+``interpret=None`` (the default) auto-selects interpreter mode off-TPU,
+which is what makes the kernel testable in the CPU tier-1 gate and
+auditable by graftprog (the registered ``attn_pallas`` program lowers
+the interpret form on the gate's pinned CPU platform).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pragma: no cover - import surface depends on the jaxlib build
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+# the ONE reference masked_fill value — imported, not redefined, so the
+# kernel's replacement bias can never drift from the einsum path's
+# (models/transformer.py only imports this module lazily inside
+# __call__, so there is no import cycle)
+from ..models.transformer import NEG_MASK_VALUE  # noqa: E402
+#: key-tail padding fill: strictly below every representable masked
+#: logit, so padded columns get exp(pad − m) = 0 even in the
+#: all-masked-row case where m == NEG_MASK_VALUE (the einsum path's
+#: uniform-over-real-keys degenerate behavior is preserved)
+_PAD_VALUE = -1e30
+
+#: default VMEM tile sizes (clamped to the padded token counts); 128
+#: matches the MXU/VPU lane width
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+#: sublane quantum that serves both f32 (8) and bf16 (16) tilings
+_SUBLANE = 16
+#: MXU/VPU lane width — the last dim of every VMEM tile pads to this
+#: on real TPU lowerings (interpret mode skips the pad)
+_LANE = 128
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _flash_attention_kernel(q_ref, k_ref, v_ref, *rest, causal: bool,
+                            has_bias: bool, t_k: int, t_k_pad: int,
+                            block_q: int, block_k: int):
+    """One (batch, head, q-block) grid cell: online-softmax attention of
+    a ``(block_q, d)`` query tile against all keys, k-tiled by
+    ``block_k``. The ``(block_q, block_k)`` logits tile is the only
+    score buffer that ever exists."""
+    if has_bias:
+        bias_ref, o_ref = rest
+    else:
+        (o_ref,) = rest
+    q = q_ref[0, 0].astype(jnp.float32)                    # (bq, d)
+    d = q.shape[-1]
+    q_row0 = pl.program_id(2) * block_q
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
+            jnp.float32)                                   # (bk, d)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bq, bk)
+        col = (j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1))
+        if has_bias:
+            # REPLACEMENT semantics (bias is 0 or NEG_MASK_VALUE): a
+            # nonzero bias overwrites the logit, exactly like the
+            # module's `where(mask == 0, NEG_MASK_VALUE, logits)` — an
+            # additive bias would cancel in softmax on all-masked rows
+            bb = bias_ref[0, 0, :, pl.ds(j * block_k, block_k)].astype(
+                jnp.float32)
+            s = jnp.where(bb != 0.0, bb, s)
+        if causal:
+            # reference mask_: upper triangle excluding the diagonal
+            row = q_row0 + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(col > row, NEG_MASK_VALUE, s)
+        # key-tail padding sits strictly below every masked logit
+        s = jnp.where(col < t_k, s, _PAD_VALUE)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)                             # f32 always
+        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
+            jnp.float32)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l * alpha + jnp.sum(p, axis=1, keepdims=True), acc
+
+    m0 = jnp.full((block_q, 1), _PAD_VALUE, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, t_k_pad // block_k, body,
+                                  (m0, l0, acc0))
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+def _reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                         bias: Optional[jnp.ndarray],
+                         causal: bool) -> jnp.ndarray:
+    """The einsum path on ``(B, H, T, D)`` layout — the semantics the
+    kernel must match, and the function whose VJP serves as the
+    kernel's backward pass (evaluated at the same inputs)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32)
+    if bias is not None:
+        s = jnp.where(bias != 0.0, bias.astype(jnp.float32), s)
+    if causal:
+        t_q, t_k = q.shape[2], k.shape[2]
+        tri = jnp.triu(jnp.ones((t_q, t_k), dtype=bool), k=1)
+        s = jnp.where(tri[None, None], NEG_MASK_VALUE, s)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@functools.lru_cache(maxsize=None)
+def _build(causal: bool, block_q: int, block_k: int, interpret: bool,
+           has_bias: bool):
+    """One differentiable pallas program per static configuration
+    (cached: ``jax.custom_vjp`` objects must be stable across traces so
+    jit caches hit)."""
+
+    def forward(q, k, v, bias):
+        b, h, t_q, d = q.shape
+        t_k = k.shape[2]
+        # clamp tiles to the (sublane-rounded) token counts, then pad
+        # tokens to tile multiples; off-TPU interpret mode skips the
+        # lane pad (no hardware tiling to satisfy)
+        bq = min(block_q, _round_up(t_q, _SUBLANE))
+        bk = min(block_k, _round_up(t_k, _SUBLANE))
+        t_q_pad = _round_up(t_q, bq)
+        t_k_pad = _round_up(t_k, bk)
+        d_pad = d if interpret else _round_up(d, _LANE)
+
+        pad = lambda x, t: jnp.pad(
+            x, ((0, 0), (0, 0), (0, t - x.shape[2]),
+                (0, d_pad - x.shape[3])))
+        qp, kp, vp = pad(q, t_q_pad), pad(k, t_k_pad), pad(v, t_k_pad)
+
+        in_specs = [
+            pl.BlockSpec((1, 1, bq, d_pad), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, t_k_pad, d_pad),
+                         lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, t_k_pad, d_pad),
+                         lambda b_, h_, i: (b_, h_, 0, 0)),
+        ]
+        args = [qp, kp, vp]
+        if has_bias:
+            h_b = bias.shape[1]             # 1 (broadcast) or H
+            bp = jnp.pad(bias, ((0, 0), (0, 0),
+                                (0, t_q_pad - bias.shape[2]),
+                                (0, t_k_pad - bias.shape[3])))
+            in_specs.append(pl.BlockSpec(
+                (1, 1, bq, t_k_pad),
+                lambda b_, h_, i, hb=h_b: (b_, h_ if hb > 1 else 0, i, 0)))
+            args.append(bp)
+
+        kernel = functools.partial(
+            _flash_attention_kernel, causal=causal, has_bias=has_bias,
+            t_k=t_k, t_k_pad=t_k_pad, block_q=bq, block_k=bk)
+        out = pl.pallas_call(
+            kernel,
+            grid=(b, h, t_q_pad // bq),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, 1, bq, d_pad),
+                                   lambda b_, h_, i: (b_, h_, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((b, h, t_q_pad, d_pad),
+                                           q.dtype),
+            interpret=interpret,
+        )(*args)
+        return out[:, :, :t_q, :d]
+
+    @jax.custom_vjp
+    def attn(q, k, v, bias):
+        return forward(q, k, v, bias)
+
+    def attn_fwd(q, k, v, bias):
+        return forward(q, k, v, bias), (q, k, v, bias)
+
+    def attn_bwd(res, g):
+        q, k, v, bias = res
+        # recompute-in-backward against the reference einsum math: exact
+        # gradients of the same function (up to float reassociation),
+        # no residual logits tensor kept from the forward
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _reference_attention(q_, k_, v_, bias,
+                                                    causal), q, k, v)
+        dq, dk, dv = vjp(g)
+        db = jnp.zeros_like(bias) if bias is not None else None
+        return dq, dk, dv, db
+
+    attn.defvjp(attn_fwd, attn_bwd)
+    return attn
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    mask: Optional[jnp.ndarray] = None,
+                    causal: bool = False, *,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Fused attention ``softmax(QK^T [masked]) V`` on ``(B, H, T, D)``
+    layout. Any Q1 query/key scaling is the caller's job (the module
+    scales both by ``head_dim**-0.25`` before calling, exactly as on
+    the einsum path).
+
+    ``mask``: optional ``(B, 1|H, T_q, T_k)``; zero entries are
+    suppressed (module semantics). ``interpret=None`` auto-selects the
+    Pallas interpreter off-TPU (CPU tier-1 gate); pass an explicit bool
+    to force either mode."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bias = None
+    if mask is not None:
+        if mask.ndim != 4:
+            raise ValueError(f"mask must be (B, 1|H, T_q, T_k), got "
+                             f"shape {mask.shape}")
+        # encode the module's replacement semantics as a float plane:
+        # 0 = keep the logit, NEG_MASK_VALUE = overwrite it
+        bias = jnp.where(mask == 0, jnp.float32(NEG_MASK_VALUE),
+                         jnp.float32(0.0))
+    fn = _build(bool(causal), int(block_q), int(block_k), bool(interpret),
+                bias is not None)
+    return fn(q, k, v, bias)
+
+
+def register_audit_programs(ctx):
+    """graftprog registry hook (``analysis/registry.py``): lower BOTH
+    kernel modes of ``MultiHeadAttention`` on the frozen audit config's
+    model shapes so each stays ratcheted and fingerprinted
+    (``analysis/programs.json``) — a silent jaxpr change in either the
+    einsum path or the pallas lowering fails the gate like every other
+    hot program. The pallas variant lowers the interpret form (the gate
+    is pinned to CPU); on-TPU it lowers to a Mosaic custom call with
+    the same kernel body."""
+    from ..analysis.registry import AuditProgram
+    from ..models.transformer import MultiHeadAttention
+
+    m = ctx.cfg.model
+    dt = jnp.dtype(m.dtype)
+    b, t = 4, 8                         # tiny token grid, audit-scale
+
+    def make(impl, fn_name):
+        mha = MultiHeadAttention(emb=m.emb, heads=m.heads,
+                                 standard_heads=m.standard_heads,
+                                 dtype=dt, attn_impl=impl)
+        q0 = jnp.zeros((b, t, m.emb), dt)
+        k0 = jnp.zeros((b, t, m.emb), dt)
+        params = jax.eval_shape(lambda: mha.init(
+            jax.random.PRNGKey(0), q0, k0))
+        aval = jax.ShapeDtypeStruct((b, t, m.emb), dt)
+
+        def apply(p, q, kk):
+            return mha.apply(p, q, kk)
+        apply.__name__ = apply.__qualname__ = fn_name
+        return AuditProgram(
+            jax.jit(apply), (params, aval, aval),
+            description=f"MultiHeadAttention ({impl} kernel mode) at "
+                        f"audit model shapes — both rollout-path "
+                        f"attention lowerings stay fingerprinted")
+
+    return {
+        "attn_xla": make("xla", "_attn_xla"),
+        "attn_pallas": make("pallas", "_attn_pallas"),
+    }

@@ -93,6 +93,7 @@ class QMixLearner:
             standard_heads=cfg.model.standard_heads,
             use_orthogonal=cfg.model.use_orthogonal,
             dtype=jnp.dtype(cfg.model.dtype),
+            attn_impl=cfg.kernels.attention,
             zero_init_gate=cfg.model.mixer_zero_init,
         )
         return cls(mac=mac, mixer=mixer, cfg=cfg,

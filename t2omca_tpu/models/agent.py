@@ -38,6 +38,7 @@ class TransformerAgent(nn.Module):
     standard_heads: bool = False
     use_orthogonal: bool = False
     dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"   # kernels.attention switch (models/transformer.py)
 
     @nn.compact
     def __call__(self, inputs: jax.Array, hidden_state: jax.Array,
@@ -57,6 +58,7 @@ class TransformerAgent(nn.Module):
             ff_hidden_mult=self.ff_hidden_mult, dropout=self.dropout,
             standard_heads=self.standard_heads,
             use_orthogonal=self.use_orthogonal, dtype=self.dtype,
+            attn_impl=self.attn_impl,
             name="transformer")(tokens, tokens, deterministic=deterministic)
 
         h_new = out[:, 0:1, :].astype(jnp.float32)  # token 0 = new hidden (:71)

@@ -42,6 +42,7 @@ class QMixFFMixer(nn.Module):
     standard_heads: bool = False
     use_orthogonal: bool = False
     dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"   # unused; interface parity (kernels.attention)
     hypernet_layers: int = 2
     hypernet_emb: int = 64
     zero_init_gate: bool = False   # ReZero output gate (see models/mixer.py)
@@ -111,6 +112,7 @@ class VDNMixer(nn.Module):
     standard_heads: bool = False
     use_orthogonal: bool = False
     dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"   # unused; interface parity (kernels.attention)
     zero_init_gate: bool = False   # accepted for registry-uniform kwargs;
     # a parameterless sum has no init-scale pathology to gate
 

@@ -60,6 +60,7 @@ class TransformerMixer(nn.Module):
     standard_heads: bool = False
     use_orthogonal: bool = False
     dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"     # kernels.attention switch (models/transformer.py)
     # ReZero-style zero-init output gate (off = reference-parity init).
     # The readout q_tot = elu(q·|w1| + b1)·|w2| + b2 contracts emb-many
     # O(1) post-LN token entries against abs-positive weights, so its init
@@ -101,6 +102,7 @@ class TransformerMixer(nn.Module):
             ff_hidden_mult=self.ff_hidden_mult, dropout=self.dropout,
             standard_heads=self.standard_heads,
             use_orthogonal=self.use_orthogonal, dtype=self.dtype,
+            attn_impl=self.attn_impl,
             name="transformer")(tokens, tokens, deterministic=deterministic)
         out = out.astype(jnp.float32)   # hypernet weights + q_tot math in f32
 

@@ -35,6 +35,7 @@ class RNNAgent(nn.Module):
     standard_heads: bool = False
     use_orthogonal: bool = False
     dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"   # unused; interface parity (kernels.attention)
 
     @nn.compact
     def __call__(self, inputs: jax.Array, hidden_state: jax.Array,

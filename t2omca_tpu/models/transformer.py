@@ -53,6 +53,13 @@ class MultiHeadAttention(nn.Module):
     standard_heads: bool = False  # perf mode: per-head dim = emb // heads
     use_orthogonal: bool = False
     dtype: jnp.dtype = jnp.float32   # compute dtype (bf16 = MXU-native perf mode)
+    # attention kernel (config kernels.attention, docs/PERF.md): "xla" =
+    # the einsum→softmax→einsum path below (default; materializes the
+    # (b, h, t_q, t_k) logits tensor); "pallas" = the fused flash-style
+    # kernel (kernels/attention.py — tiled online softmax, f32
+    # accumulators, logits live only in VMEM). Parity pinned by
+    # tests/test_kernels.py; interpret mode makes pallas CPU-testable.
+    attn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, q: jax.Array, k: jax.Array,
@@ -60,6 +67,7 @@ class MultiHeadAttention(nn.Module):
         b, t_q, e_q = q.shape
         _, t_k, e = k.shape
         assert e == e_q == self.emb, (e, e_q, self.emb)
+        assert self.attn_impl in ("xla", "pallas"), self.attn_impl
         h = self.heads
         if self.standard_heads:
             assert self.emb % h == 0
@@ -79,6 +87,28 @@ class MultiHeadAttention(nn.Module):
         queries = queries * scale
         keys = keys * scale
 
+        if mask is not None:
+            # padding mask: 0 entries are suppressed (transformer.py:72-73).
+            # Accepts (b, t_q, t_k) — broadcast over heads — or (b, h/1, t_q, t_k).
+            if mask.ndim == 3:
+                mask = mask[:, None, :, :]
+            assert mask.ndim == 4, f"mask must be 3D or 4D, got {mask.shape}"
+
+        if self.attn_impl == "pallas":
+            # fused flash kernel: tiled QK^T → masked online softmax →
+            # PV, f32 accumulators, never materializing the logits
+            # tensor (kernels/attention.py). Same mask/causal semantics
+            # as below; softmax statistics are f32 in BOTH dtypes (the
+            # bf16 path is better-conditioned than the einsum one).
+            from ..kernels.attention import flash_attention
+            out = flash_attention(
+                jnp.swapaxes(queries, 1, 2), jnp.swapaxes(keys, 1, 2),
+                jnp.swapaxes(values, 1, 2), mask=mask, causal=self.causal)
+            out = jnp.swapaxes(out, 1, 2).reshape(b, t_q, h * head_dim)
+            return nn.Dense(self.emb, name="unifyheads", dtype=self.dtype,
+                            kernel_init=orthogonal_or_default(
+                                self.use_orthogonal))(out)
+
         logits = jnp.einsum("bqhd,bkhd->bhqk", queries, keys)
 
         if self.causal:
@@ -87,11 +117,6 @@ class MultiHeadAttention(nn.Module):
             tri = jnp.triu(jnp.ones((t_q, t_k), dtype=bool), k=1)
             logits = jnp.where(tri[None, None], -jnp.inf, logits)
         if mask is not None:
-            # padding mask: 0 entries are suppressed (transformer.py:72-73).
-            # Accepts (b, t_q, t_k) — broadcast over heads — or (b, h/1, t_q, t_k).
-            if mask.ndim == 3:
-                mask = mask[:, None, :, :]
-            assert mask.ndim == 4, f"mask must be 3D or 4D, got {mask.shape}"
             logits = jnp.where(mask == 0, NEG_MASK_VALUE, logits)
 
         # parity mode (f32) keeps f32 softmax; bf16 perf mode stays in bf16
@@ -120,6 +145,7 @@ class TransformerBlock(nn.Module):
     standard_heads: bool = False
     use_orthogonal: bool = False
     dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"        # kernels.attention switch (see MHA)
 
     @nn.compact
     def __call__(self, q: jax.Array, k: jax.Array,
@@ -129,6 +155,7 @@ class TransformerBlock(nn.Module):
             emb=self.emb, heads=self.heads, causal=self.causal,
             standard_heads=self.standard_heads,
             use_orthogonal=self.use_orthogonal, dtype=self.dtype,
+            attn_impl=self.attn_impl,
             name="attention")(q, k, mask)
 
         x = nn.LayerNorm(name="norm1", dtype=self.dtype)(attended + q)
@@ -161,6 +188,7 @@ class Transformer(nn.Module):
     standard_heads: bool = False
     use_orthogonal: bool = False
     dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"        # kernels.attention switch (see MHA)
 
     @nn.compact
     def __call__(self, q: jax.Array, k: jax.Array,
@@ -173,5 +201,6 @@ class Transformer(nn.Module):
                 ff_hidden_mult=self.ff_hidden_mult, dropout=self.dropout,
                 standard_heads=self.standard_heads,
                 use_orthogonal=self.use_orthogonal, dtype=self.dtype,
+                attn_impl=self.attn_impl,
                 name=f"block_{i}")(x, k, mask, deterministic=deterministic)
         return x

@@ -190,6 +190,32 @@ def build_rows(phases: Dict[str, dict], device_times: Dict[str, dict],
             row["gflop_disp"] / (per_disp_ms / 1000.0)
             if row["gflop_disp"] and per_disp_ms else None)
         rows.append(row)
+    # device-attributed programs with no dispatch span of their own —
+    # the fused Pallas kernels (attn_pallas etc.) show up only as device
+    # kernel launches inside a larger program's dispatch. Without this
+    # they would silently vanish from the table (their device time
+    # dropped into the unattributed bucket); budgets stay unscaled (no
+    # run-shape mapping for a kernel fragment — stated via sf=None).
+    spanned = {r["program"] for r in rows}
+    for prog_name in sorted(set(device_times) - spanned):
+        dev = device_times[prog_name]
+        entry = programs.get(prog_name, {})
+        flops = entry.get("flops")
+        bytes_ = entry.get("bytes_accessed")
+        per_disp_ms = dev.get("median_ms") or (
+            dev["device_ms"] / dev["events"] if dev.get("events") else None)
+        rows.append({
+            "phase": "(trace-only)", "program": prog_name,
+            "n": dev.get("events", 0), "first_ms": -1.0,
+            "steady_ms": -1.0, "total_ms": dev.get("device_ms", 0.0),
+            "device_ms": dev.get("device_ms"),
+            "device_events": dev.get("events"),
+            "flops_audit": flops, "bytes_audit": bytes_,
+            "intensity": (flops / bytes_ if flops and bytes_ else None),
+            "gflop_disp": None, "gb_disp": None,
+            "per_disp_ms": per_disp_ms, "time_source": "device",
+            "achieved_gflops": None,
+        })
     return rows
 
 

@@ -145,7 +145,11 @@ def load_acting_params(cfg: TrainConfig, ckpt_dir: str, load_step: int = 0):
             f"checkpoint {dirname} holds a different MODEL than the "
             f"export config: {len(bad)} agent leaves mismatch (first: "
             f"{bad[0]}) — pass the training run's config")
-    acting = mac.prepare_acting_params(params)
+    # fold at the TRAIN dtype explicitly: model.act_dtype is a
+    # training-run rollout knob, and letting it leak into the fold would
+    # ship bf16 leaves inside the artifact's canonical "float32" variant
+    # (voiding the f32 bit-parity serving contract above)
+    acting = mac.prepare_acting_params(params, dtype=mac.agent.dtype)
     ckpt_info = {"dir": dirname, "t_env": int(step),
                  "state_sha256": (ckpt_meta or {}).get("sha256")}
     return acting, mac, env_info, ckpt_info

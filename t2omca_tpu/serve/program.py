@@ -45,6 +45,12 @@ def build_serve_step(mac):
     the qslice forward is exact for the same params."""
 
     def _serve_step(params, obs, avail, hidden):
+        # train-dtype forward (acting=False default): serving's dtype
+        # story is the artifact's per-variant cast, NOT the training
+        # run's model.act_dtype rollout knob — the exporter folds at the
+        # train dtype for the same reason (export.py), so fold and
+        # forward always agree and the f32 variant keeps its bit-parity
+        # contract with the training path's greedy select_actions
         if mac.use_qslice:
             q, hidden = mac.forward_qslice(params, obs, hidden, key=None,
                                            deterministic=True)
@@ -80,8 +86,10 @@ def register_audit_programs(ctx):
     mac = ctx.exp.mac
     env_info = ctx.exp.env.get_env_info()
     step = build_serve_step(mac)
-    params = jax.eval_shape(mac.prepare_acting_params,
-                            ctx.ts_shape.learner.params["agent"])
+    # train-dtype fold, like the exporter (act_dtype never reaches serving)
+    params = jax.eval_shape(
+        lambda p: mac.prepare_acting_params(p, dtype=mac.agent.dtype),
+        ctx.ts_shape.learner.params["agent"])
     obs, avail, hidden = serve_avals(mac, env_info["obs_shape"],
                                      env_info["n_actions"],
                                      SERVE_AUDIT_BATCH)

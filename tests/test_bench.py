@@ -68,6 +68,29 @@ def test_acting_selector_reported(acting):
     assert rec["value"] > 0
 
 
+@pytest.mark.slow   # subprocess + two fresh dense-rollout jits (xla + pallas
+                    # interpret) — the --kernels A/B contract (docs/PERF.md)
+def test_kernels_ab_leg_one_record_per_mode():
+    """``--kernels ab``: one record per kernel mode, each carrying the
+    mode, the forced dense acting path, and its own per-mode span legs —
+    the attributable A/B the roofline report joins against."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke", "--kernels", "ab",
+         "--envs", "4", "--steps", "4"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    recs = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    assert [r["kernels"] for r in recs] == ["xla", "pallas"]
+    for rec in recs:
+        assert rec["metric"] == "env_steps_per_sec"
+        assert rec["acting"] == "dense"
+        assert isinstance(rec["value"], (int, float)) and rec["value"] > 0
+        assert "bench.measure" in rec["spans"]
+
+
 @pytest.mark.slow   # subprocess + fresh jit; rbg impl pinned cheaply in test_driver
 def test_prng_rbg_end_to_end():
     """--prng rbg routes every key through the XLA RngBitGenerator (the

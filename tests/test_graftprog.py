@@ -102,6 +102,64 @@ def test_gp204_callback():
     assert "pure_callback" in rep.rule_details["GP204"][0]
 
 
+def test_gp204_pallas_call_is_not_a_host_callback():
+    """A ``pallas_call`` is a device kernel launch (Mosaic custom call /
+    CPU interpreter), not a host round-trip — graftprog must never
+    classify it under GP204, whatever substring its primitive name
+    grows (PR 9 kernels/ layer)."""
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    def f(x):
+        return pl.pallas_call(
+            kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=True)(x)
+
+    rep = _audit(jax.jit(f), (jax.ShapeDtypeStruct((8, 128),
+                                                   jnp.float32),))
+    assert rep.rule_count("GP204") == 0
+
+
+def test_gp202_skips_pallas_kernel_block_specs():
+    """The kernel jaxpr's closed-over block-spec/grid machinery (and any
+    constants the kernel body materializes, like a large iota grid) is
+    device-kernel plumbing, not a baked host array — the GP202 walk
+    treats the pallas_call as opaque. A genuine host-level closure
+    constant NEXT TO the kernel must still be flagged."""
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        # a >16 KiB constant INSIDE the kernel body (64x128 f32 iota =
+        # 32 KiB): must not trip the host-constant rule
+        grid = jax.lax.broadcasted_iota(jnp.float32, (64, 128), 0)
+        o_ref[...] = x_ref[...] + grid
+
+    def gridded(x):
+        return pl.pallas_call(
+            kernel,
+            grid=(2,),
+            in_specs=[pl.BlockSpec((64, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((64, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=True)(x)
+
+    rep = _audit(jax.jit(gridded),
+                 (jax.ShapeDtypeStruct((128, 128), jnp.float32),))
+    assert rep.rule_count("GP202") == 0
+    assert rep.rule_count("GP204") == 0
+
+    big = jnp.ones((256, 256), jnp.float32)      # host-level: still flagged
+
+    def with_host_const(x):
+        return gridded(x) @ big
+
+    rep = _audit(jax.jit(with_host_const),
+                 (jax.ShapeDtypeStruct((128, 256), jnp.float32),))
+    assert rep.rule_count("GP202") == 1
+
+
 def test_clean_program_no_findings_and_metrics():
     def f(x):
         return x * 2.0
@@ -369,7 +427,8 @@ def test_registry_names_and_structure():
     from t2omca_tpu.analysis.registry import collect_default_programs
     reg = collect_default_programs()
     assert set(reg) == {"rollout", "insert", "train_iter", "superstep",
-                        "dp_superstep", "learner_train"}
+                        "dp_superstep", "learner_train", "serve_step",
+                        "attn_xla", "attn_pallas"}
     # the donated hot programs are the compiled (memory-audited) ones
     assert reg["superstep"].compile and reg["train_iter"].compile
     assert reg["superstep"].donate_argnums == (0,)

@@ -1,0 +1,346 @@
+"""Coverage for the rollout hot-path kernel layer (t2omca_tpu/kernels/,
+docs/PERF.md): the Pallas fused attention kernel vs the einsum path, the
+single-scatter time-major ring insert, and the bf16 acting-dtype mode —
+the PR-9 parity contracts the CPU tier-1 gate pins.
+
+The pallas kernel runs in interpreter mode here (interpret auto-selects
+off-TPU), so every assertion below holds for the exact kernel body that
+lowers to Mosaic on a real chip."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from t2omca_tpu.config import (EnvConfig, KernelsConfig, ModelConfig,
+                               ReplayConfig, TrainConfig, from_dict,
+                               sanity_check)
+from t2omca_tpu.kernels.attention import (NEG_MASK_VALUE,
+                                          _reference_attention,
+                                          flash_attention)
+from t2omca_tpu.models.transformer import MultiHeadAttention
+
+
+def _rand(rng, shape, dtype=jnp.float32):
+    return jnp.asarray(rng.standard_normal(shape), dtype)
+
+
+def _mask_bias(mask):
+    return None if mask is None else jnp.where(mask, 0.0, NEG_MASK_VALUE)
+
+
+# ------------------------------------------------------- kernel vs einsum
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("masked", [False, True])
+def test_flash_matches_einsum_f32(causal, masked):
+    """f32 parity: online softmax vs max-subtracted softmax is the same
+    math under a different association — per-element error must sit at
+    float-reassociation scale, orders below any training tolerance."""
+    rng = np.random.default_rng(0)
+    b, h, t, d = 2, 3, 9, 16
+    q, k, v = (_rand(rng, (b, h, t, d)) for _ in range(3))
+    mask = jnp.asarray(rng.random((b, 1, t, t)) > 0.3) if masked else None
+    out = flash_attention(q, k, v, mask=mask, causal=causal)
+    ref = _reference_attention(q, k, v, _mask_bias(mask), causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=2e-6)
+
+
+def test_flash_odd_shapes_padding():
+    """Token/head dims that don't divide the tile sizes exercise the
+    pad-and-mask tail path (t_q=5, t_k=7, d=12 — none tile-aligned)."""
+    rng = np.random.default_rng(1)
+    q = _rand(rng, (2, 2, 5, 12))
+    k = _rand(rng, (2, 2, 7, 12))
+    v = _rand(rng, (2, 2, 7, 12))
+    mask = jnp.asarray(rng.random((2, 1, 5, 7)) > 0.4)
+    out = flash_attention(q, k, v, mask=mask)
+    ref = _reference_attention(q, k, v, _mask_bias(mask), False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=2e-6)
+
+
+def test_flash_small_blocks_multi_tile():
+    """Explicit tiny tiles force a real multi-block online-softmax pass
+    (several k-block iterations carrying the running max/denominator)."""
+    rng = np.random.default_rng(2)
+    q, k, v = (_rand(rng, (1, 2, 40, 8)) for _ in range(3))
+    out = flash_attention(q, k, v, block_q=16, block_k=16)
+    ref = _reference_attention(q, k, v, None, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=2e-6)
+
+
+def test_flash_bf16_within_tolerance():
+    """bf16 inputs, f32 accumulators: the kernel is *better*-conditioned
+    than the einsum bf16 path (which softmaxes in bf16), so comparing
+    against the f32 reference bounds both."""
+    rng = np.random.default_rng(3)
+    q, k, v = (_rand(rng, (2, 2, 17, 8), jnp.bfloat16) for _ in range(3))
+    out = flash_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    ref = _reference_attention(q.astype(jnp.float32),
+                               k.astype(jnp.float32),
+                               v.astype(jnp.float32), None, False)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=0.05, atol=0.02)
+
+
+def test_flash_fully_masked_row_matches_einsum_degenerate():
+    """All-masked rows degrade to the einsum path's uniform distribution
+    (replacement semantics — an additive bias would silently cancel)."""
+    rng = np.random.default_rng(4)
+    q, k, v = (_rand(rng, (1, 1, 4, 8)) for _ in range(3))
+    mask = jnp.ones((1, 1, 4, 4), bool).at[0, 0, 2].set(False)
+    out = flash_attention(q, k, v, mask=mask)
+    ref = _reference_attention(q, k, v, _mask_bias(mask), False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=2e-6)
+    # the degenerate row really is the uniform mean of V
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 2],
+                               np.asarray(v).mean(axis=2)[0, 0],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_gradients_match_einsum():
+    """The custom VJP (recompute-in-backward against the einsum math)
+    must yield the einsum path's gradients at the same inputs — the
+    learner's dense unroll trains straight through the kernel."""
+    rng = np.random.default_rng(5)
+    q, k, v = (_rand(rng, (2, 2, 7, 8)) for _ in range(3))
+    mask = jnp.asarray(rng.random((2, 1, 7, 7)) > 0.3)
+    bias = _mask_bias(mask)
+
+    def loss_p(q, k, v):
+        return (flash_attention(q, k, v, mask=mask) ** 2).sum()
+
+    def loss_r(q, k, v):
+        return (_reference_attention(q, k, v, bias, False) ** 2).sum()
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------- module-level switch
+
+@pytest.mark.parametrize("standard_heads", [False, True])
+def test_mha_pallas_matches_xla(standard_heads):
+    """MultiHeadAttention(attn_impl=pallas) == the einsum module over
+    the SAME params — both the Q1 full-emb and standard head
+    geometries."""
+    rng = np.random.default_rng(6)
+    x = _rand(rng, (3, 7, 16))
+    kw = dict(emb=16, heads=2, standard_heads=standard_heads)
+    mx = MultiHeadAttention(**kw)
+    mp = MultiHeadAttention(**kw, attn_impl="pallas")
+    params = mx.init(jax.random.PRNGKey(0), x, x)
+    np.testing.assert_allclose(np.asarray(mx.apply(params, x, x)),
+                               np.asarray(mp.apply(params, x, x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_mha_rejects_unknown_impl():
+    x = jnp.zeros((1, 2, 8))
+    m = MultiHeadAttention(emb=8, heads=2, attn_impl="cuda")
+    with pytest.raises(AssertionError):
+        m.init(jax.random.PRNGKey(0), x, x)
+
+
+# ------------------------------------------------------- config plumbing
+
+def test_kernels_config_sanity_and_merge():
+    cfg = sanity_check(TrainConfig(kernels=KernelsConfig(
+        attention="pallas")))
+    assert cfg.kernels.attention == "pallas"
+    with pytest.raises(ValueError, match="kernels.attention"):
+        sanity_check(TrainConfig(kernels=KernelsConfig(attention="cuda")))
+    # nested-dict + flat-key routing, and the meta.json roundtrip
+    cfg = from_dict({"kernels": {"attention": "pallas"},
+                     "model": {"act_dtype": "bfloat16"}})
+    assert cfg.kernels.attention == "pallas"
+    assert cfg.model.act_dtype == "bfloat16"
+    rt = from_dict(dataclasses.asdict(cfg))
+    assert rt.kernels.attention == "pallas"
+
+
+def test_act_dtype_sanity():
+    with pytest.raises(ValueError, match="act_dtype"):
+        sanity_check(TrainConfig(model=ModelConfig(act_dtype="float16")))
+
+
+# ----------------------------------------- integration (tiny Experiment)
+
+def _tiny_cfg(**kw):
+    model_kw = kw.pop("model", {})
+    return sanity_check(TrainConfig(
+        batch_size_run=2, batch_size=2,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=4),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1, **model_kw),
+        replay=ReplayConfig(buffer_size=8), **kw))
+
+
+@pytest.fixture(scope="module")
+def tiny_exp():
+    from t2omca_tpu.run import Experiment
+    exp = Experiment.build(_tiny_cfg())
+    ts = exp.init_train_state(0)
+    rs, tm, _ = exp.runner.run_raw(ts.learner.params["agent"], ts.runner)
+    return exp, ts, tm
+
+
+def test_single_scatter_insert_bit_identical(tiny_exp):
+    """insert_time_major (ONE combined-index scatter per leaf) must stay
+    bit-identical to insert_episode_batch(to_batch()) — including across
+    ring wraparound, where the slot set is non-contiguous."""
+    exp, _, tm = tiny_exp
+    buf = exp.buffer
+    st = buf.init()
+    for _ in range(5):                  # 10 episodes through capacity 8
+        a = buf.insert_time_major(st, tm)
+        b = buf.insert_episode_batch(st, tm.to_batch())
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            assert (np.asarray(la) == np.asarray(lb)).all()
+        st = a
+    assert int(st.episodes_in_buffer) == buf.capacity
+
+
+def test_acting_default_bit_identical_to_train_forward(tiny_exp):
+    """act_dtype unset: the acting fold + acting=True forward must be
+    bit-identical to the training-path forward (the serving f32 parity
+    contract rides on this)."""
+    exp, ts, _ = tiny_exp
+    mac = exp.mac
+    p = ts.learner.params["agent"]
+    rng = np.random.default_rng(7)
+    obs = _rand(rng, (2, mac.n_agents, exp.env.obs_dim))
+    hid = mac.init_hidden(2)
+    fp = mac.prepare_acting_params(p)
+    q_act, h_act = mac.forward_qslice(fp, obs, hid, acting=True)
+    q_tr, h_tr = mac.forward_qslice(fp, obs, hid, acting=False)
+    assert (np.asarray(q_act) == np.asarray(q_tr)).all()
+    assert (np.asarray(h_act) == np.asarray(h_tr)).all()
+
+
+def test_bf16_acting_within_tolerance(tiny_exp):
+    """model.act_dtype=bfloat16 over an f32 train dtype: acting q-values
+    stay within the established bf16 tolerance of the f32 path, greedy
+    actions agree, and the TRAIN-path forward is untouched (bit-equal
+    params/unroll dtype)."""
+    from t2omca_tpu.run import Experiment
+    exp32, ts, _ = tiny_exp
+    expb = Experiment.build(_tiny_cfg(model={"act_dtype": "bfloat16"}))
+    mac32, macb = exp32.mac, expb.mac
+    assert macb.act_agent is None or macb.act_agent.dtype == jnp.bfloat16
+    p = ts.learner.params["agent"]
+    rng = np.random.default_rng(8)
+    obs = _rand(rng, (2, mac32.n_agents, exp32.env.obs_dim))
+    hid = mac32.init_hidden(2)
+    avail = jnp.ones((2, mac32.n_agents, mac32.n_actions))
+
+    fp32 = mac32.prepare_acting_params(p)
+    fpb = macb.prepare_acting_params(p)
+    # the acting fold really is bf16 (params halved per scan step)
+    assert fpb["tf"]["blocks"][0]["wqk"].dtype == jnp.bfloat16
+    q32, _ = mac32.forward_qslice(fp32, obs, hid, acting=True)
+    qb, _ = macb.forward_qslice(fpb, obs, hid, acting=True)
+    np.testing.assert_allclose(np.asarray(qb), np.asarray(q32),
+                               rtol=0.05, atol=0.05)
+    a32, _, _ = mac32.select_actions(fp32, obs, avail, hid,
+                                     jax.random.PRNGKey(0), jnp.asarray(0),
+                                     test_mode=True)
+    ab, _, _ = macb.select_actions(fpb, obs, avail, hid,
+                                   jax.random.PRNGKey(0), jnp.asarray(0),
+                                   test_mode=True)
+    assert (np.asarray(a32) == np.asarray(ab)).mean() > 0.9
+    # train path untouched: learner-side forward ignores act_dtype
+    qt32, _ = mac32.forward_qslice(p, obs, hid)
+    qtb, _ = macb.forward_qslice(p, obs, hid)
+    assert (np.asarray(qt32) == np.asarray(qtb)).all()
+
+
+def test_bf16_acting_dense_path_uses_act_agent():
+    """The DENSE acting path under act_dtype=bfloat16: BasicMAC.forward
+    (acting=True) must route through the bf16 act_agent module clone,
+    produce q within the bf16 tolerance of the f32 module, and leave
+    the train-path forward (acting=False) bit-identical."""
+    from t2omca_tpu.run import Experiment
+    exp32 = Experiment.build(_tiny_cfg(model={"use_qslice": False}))
+    expb = Experiment.build(_tiny_cfg(model={"use_qslice": False,
+                                             "act_dtype": "bfloat16"}))
+    mac32, macb = exp32.mac, expb.mac
+    assert macb.act_agent is not None
+    assert macb.act_agent.dtype == jnp.bfloat16
+    assert macb.agent.dtype == jnp.float32      # train module untouched
+    ts = exp32.init_train_state(0)
+    p = ts.learner.params["agent"]
+    rng = np.random.default_rng(9)
+    obs = _rand(rng, (2, mac32.n_agents, exp32.env.obs_dim))
+    hid = mac32.init_hidden(2)
+    # dense path: prepare_acting_params pre-casts the raw tree
+    pb = macb.prepare_acting_params(p)
+    assert jax.tree.leaves(pb)[0].dtype == jnp.bfloat16
+    q32, h32 = mac32.forward(p, obs, hid, acting=True)
+    qb, hb = macb.forward(pb, obs, hid, acting=True)
+    np.testing.assert_allclose(np.asarray(qb), np.asarray(q32),
+                               rtol=0.05, atol=0.05)
+    # train-path forward ignores act_dtype AND the acting clone
+    qt32, _ = mac32.forward(p, obs, hid)
+    qtb, _ = macb.forward(p, obs, hid)
+    assert (np.asarray(qt32) == np.asarray(qtb)).all()
+    # the full select_actions greedy path agrees across dtypes
+    avail = jnp.ones((2, mac32.n_agents, mac32.n_actions))
+    a32, _, _ = mac32.select_actions(
+        mac32.prepare_acting_params(p), obs, avail, hid,
+        jax.random.PRNGKey(0), jnp.asarray(0), test_mode=True)
+    ab, _, _ = macb.select_actions(pb, obs, avail, hid,
+                                   jax.random.PRNGKey(0), jnp.asarray(0),
+                                   test_mode=True)
+    assert (np.asarray(a32) == np.asarray(ab)).mean() > 0.9
+
+
+def test_export_fold_stays_train_dtype_under_act_dtype():
+    """The serving exporter folds at the TRAIN dtype even when the
+    training config sets act_dtype=bfloat16 — the artifact's canonical
+    f32 variant must never silently contain bf16 leaves
+    (serve/export.py f32 bit-parity contract)."""
+    from t2omca_tpu.run import Experiment
+    expb = Experiment.build(_tiny_cfg(model={"act_dtype": "bfloat16"}))
+    ts = expb.init_train_state(0)
+    p = ts.learner.params["agent"]
+    folded = expb.mac.prepare_acting_params(p, dtype=expb.mac.agent.dtype)
+    for leaf in jax.tree.leaves(folded):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                     jnp.floating):
+            assert leaf.dtype == jnp.float32, leaf.dtype
+
+
+@pytest.mark.slow    # full rollout jit x2 (dense acting, ~40 s on 2 cores)
+def test_dense_rollout_pallas_matches_xla():
+    """End-to-end: the dense-acting rollout under kernels.attention=
+    pallas selects bit-identical actions to the einsum path at f32 (the
+    selector argmax absorbs reassociation-scale q differences), so the
+    env stream — and therefore the whole episode batch — matches."""
+    from t2omca_tpu.run import Experiment
+    outs = {}
+    for mode in ("xla", "pallas"):
+        exp = Experiment.build(_tiny_cfg(
+            model={"use_qslice": False},
+            kernels=KernelsConfig(attention=mode)))
+        ts = exp.init_train_state(0)
+        _, batch, stats = exp.runner.run(ts.learner.params["agent"],
+                                         ts.runner)
+        outs[mode] = (batch, stats)
+    bx, sx = outs["xla"]
+    bp, sp = outs["pallas"]
+    assert (np.asarray(bx.actions) == np.asarray(bp.actions)).all()
+    np.testing.assert_allclose(np.asarray(sx.episode_return),
+                               np.asarray(sp.episode_return),
+                               rtol=1e-5, atol=1e-5)

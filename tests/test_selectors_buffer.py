@@ -146,6 +146,57 @@ def test_per_importance_weights_anneal_to_one():
     assert (np.diff(w1[order]) <= 1e-6).all()
 
 
+def test_per_stratified_sample_partial_fill_stays_in_bounds():
+    """PER stratified inverse-CDF on a PARTIALLY-filled ring: the cdf
+    plateaus at its total past ``episodes_in_buffer``, and
+    ``searchsorted(side='left')`` on a plateau must resolve to the LAST
+    valid slot, never an empty tail slot — across many keys and both
+    ends of the β anneal (episode_buffer.PrioritizedReplayBuffer
+    .sample)."""
+    buf = _buf(PrioritizedReplayBuffer, cap=16, alpha=0.6, beta0=0.4,
+               t_max=100)
+    s = buf.insert_episode_batch(buf.init(), _make_batch(5))
+    s = buf.update_priorities(s, jnp.arange(5),
+                              jnp.asarray([4.0, 0.5, 2.0, 1.0, 3.0]))
+    n = int(s.episodes_in_buffer)
+    assert n == 5
+    for i in range(25):
+        for t_env in (0, 100):
+            _, idx, w = buf.sample(s, jax.random.PRNGKey(i), 8,
+                                   t_env=t_env)
+            idx, w = np.asarray(idx), np.asarray(w)
+            assert (idx >= 0).all() and (idx < n).all(), idx
+            assert np.isfinite(w).all() and (w > 0).all()
+            assert float(w.max()) == pytest.approx(1.0)
+
+
+def test_per_weights_ignore_zero_priority_tail_slots():
+    """Garbage priorities in the UNFILLED tail (e.g. stale values left
+    by a wraparound-adjacent bug) must not leak into the sampling
+    distribution or the importance weights: _probs masks on
+    episodes_in_buffer, not on the priorities array."""
+    buf = _buf(PrioritizedReplayBuffer, cap=8, alpha=1.0, beta0=1.0,
+               t_max=1)
+    s = buf.insert_episode_batch(buf.init(), _make_batch(3))
+    s = buf.update_priorities(s, jnp.arange(3),
+                              jnp.asarray([1.0, 2.0, 1.0]))
+    # poison the tail: enormous priorities in never-filled slots
+    s = s.replace(priorities=s.priorities.at[3:].set(1e6))
+    seen = set()
+    for i in range(30):
+        _, idx, w = buf.sample(s, jax.random.PRNGKey(i), 4, t_env=1)
+        idx, w = np.asarray(idx), np.asarray(w)
+        assert (idx < 3).all(), idx              # tail never sampled
+        seen.update(idx.tolist())
+        # β=1 exact correction over the VALID mass only: w ∝ 1/p with
+        # p from the 3 real episodes (1+2+1), max-normalized — the
+        # poisoned tail would have crushed these toward 0
+        pri = np.asarray(s.priorities)[idx]
+        expect = (1.0 / pri) / (1.0 / pri).max()
+        np.testing.assert_allclose(w, expect, rtol=1e-5)
+    assert seen == {0, 1, 2}
+
+
 def test_per_new_episodes_get_max_priority():
     buf = _buf(PrioritizedReplayBuffer, cap=4, alpha=1.0, beta0=0.4,
                t_max=100)
