@@ -505,6 +505,221 @@ def bench_kernels(make_cfg_kernels, _time, args) -> int:
     return rc
 
 
+def bench_sebulba(cfg, _time, args) -> int:
+    """``--sebulba``: the decoupled actor/learner A/B (ROADMAP item 2).
+
+    Measures the same chained rollout→insert→train workload three ways
+    and reports all of them in ONE record:
+
+    * **classic** (context) — the classic three-program loop on a
+      single device, async-chained with one terminal sync: today's
+      default driver shape;
+    * **serialized** — the SPLIT pipeline (1 actor + 1 learner device,
+      ``parallel/sebulba.py``) run strictly phase-by-phase: each stage
+      (rollout, queue hop, train, params publish) blocks to completion
+      before the next starts. This is the serialized regime the
+      decoupled architecture exists to remove — identical per-iteration
+      work to the overlapped leg, so the A/B isolates exactly what
+      overlap buys;
+    * **overlapped** — the same split driven the way
+      ``run.run_sebulba`` drives it: an actor thread rollouts and feeds
+      the device-resident trajectory queue while the main thread
+      consumes, trains and publishes params back, no per-stage syncs.
+      Wall-clock covers the same k batches produced AND consumed.
+
+    Headline = overlapped env-steps/s (training included);
+    ``overlap_speedup`` = overlapped/serialized. On a real 2-chip split
+    the two phases also overlap in COMPUTE; on a CPU smoke host the
+    devices share cores, so the speedup there measures the removed
+    serialization points only (stated by the record's backend field).
+    Needs ≥ 2 devices (``--smoke`` forces 2 CPU host devices)."""
+    import dataclasses
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from t2omca_tpu.config import SebulbaConfig
+    from t2omca_tpu.parallel.sebulba import make_sebulba
+    from t2omca_tpu.run import Experiment
+
+    k = max(2 * args.iters, 6)
+    bs = 4 if args.smoke else 32
+    b, t_len = cfg.batch_size_run, cfg.env_args.episode_limit
+    env_steps = k * b * t_len
+    cfg = cfg.replace(
+        batch_size=bs,
+        replay=dataclasses.replace(
+            cfg.replay, prioritized=True,
+            buffer_size=max(cfg.replay.buffer_size, 2 * b, bs)))
+
+    # ---- classic context leg: one device, async-chained loop ----------
+    with _REC.span("bench.build", leg="sebulba-classic"):
+        exp = Experiment.build(cfg)
+        ts = exp.init_train_state(0)
+    rollout, insert, train_iter = exp.jitted_programs()
+    key = jax.random.PRNGKey(7)
+
+    def classic_iter(ts, i):
+        rs, batch, _ = rollout(ts.learner.params["agent"], ts.runner,
+                               test_mode=False)
+        ts = ts.replace(runner=rs, buffer=insert(ts.buffer, batch),
+                        episode=ts.episode + b)
+        ts, info = train_iter(ts, jax.random.fold_in(key, i),
+                              jnp.asarray(1000 + i))
+        return ts, info
+
+    with _REC.span("bench.compile", leg="sebulba-classic"):
+        ts, info = classic_iter(ts, 0)      # compile + ring fill
+        _sync(info["loss"])
+    with _REC.span("bench.measure", leg="sebulba-classic"):
+        t0 = time.perf_counter()
+        for i in range(k):
+            ts, info = classic_iter(ts, 1 + i)
+        _sync(info["loss"])
+        dt_classic = time.perf_counter() - t0
+    rate_classic = env_steps / dt_classic
+    print(f"# sebulba A/B classic (1 device, async chain): "
+          f"{dt_classic * 1e3:.1f} ms for {env_steps} env-steps + {k} "
+          f"train iters -> {rate_classic:,.0f} env-steps/s",
+          file=sys.stderr)
+    del ts, rollout, insert, train_iter, exp
+
+    # ---- overlapped: 1 actor + 1 learner device ------------------------
+    seb_cfg = cfg.replace(sebulba=SebulbaConfig(
+        actor_devices=1, learner_devices=1, queue_slots=2, staleness=1))
+    with _REC.span("bench.build", leg="sebulba-overlap"):
+        exp2 = Experiment.build(seb_cfg)
+        seb = make_sebulba(exp2)
+        rs, ls = seb.init_states(0)
+        q = seb.init_queue()
+    actor_step, queue_put, queue_get, learner_step = seb.programs()
+    sb = seb_cfg.sebulba
+
+    with _REC.span("bench.compile", leg="sebulba-overlap"):
+        # warm every program once (compiles + ring fill so the timed
+        # iterations all take the train branch)
+        params = seb.publish_params(ls.learner.params["agent"])
+        rs, tm, _ = actor_step(params, rs, test_mode=False)
+        q = queue_put(q, jnp.asarray(0, jnp.int32), seb.to_learner(tm))
+        ls, q = queue_get(ls, q, jnp.asarray(0, jnp.int32))
+        ls, info = learner_step(ls, jax.random.fold_in(key, 999),
+                                jnp.asarray(1000))
+        _sync(info["loss"])
+
+    # ---- serialized split: IDENTICAL per-iteration work, every stage
+    # blocked to completion before the next starts — the serialized
+    # regime the decoupled loop removes
+    with _REC.span("bench.measure", leg="sebulba-serial"):
+        t0 = time.perf_counter()
+        params = seb.publish_params(ls.learner.params["agent"])
+        jax.block_until_ready(params)
+        for i in range(k):
+            rs, tm, stats = actor_step(params, rs, test_mode=False)
+            jax.block_until_ready(stats.epsilon)
+            tm_l = seb.to_learner(tm)
+            jax.block_until_ready(tm_l.reward)
+            q = queue_put(q, jnp.asarray(0, jnp.int32), tm_l)
+            ls, q = queue_get(ls, q, jnp.asarray(0, jnp.int32))
+            ls, info = learner_step(ls, jax.random.fold_in(key, 3000 + i),
+                                    jnp.asarray(3000 + i))
+            _sync(info["loss"])
+            params = seb.publish_params(ls.learner.params["agent"])
+            jax.block_until_ready(params)
+        dt_serial = time.perf_counter() - t0
+    rate_serial = env_steps / dt_serial
+    print(f"# sebulba A/B serialized split (1+1 devices, stage-"
+          f"synchronized): {dt_serial * 1e3:.1f} ms -> "
+          f"{rate_serial:,.0f} env-steps/s", file=sys.stderr)
+
+    cond = threading.Condition()
+    shared = {"q": q, "params": seb.publish_params(
+        ls.learner.params["agent"]), "put": 0, "consumed": 0,
+        "error": None}
+
+    def actor(rs=rs):
+        try:
+            for i in range(k):
+                with cond:
+                    while (i - shared["consumed"] > sb.staleness
+                           or shared["put"] - shared["consumed"]
+                           >= sb.queue_slots):
+                        cond.wait()
+                    params = shared["params"]
+                rs, tm, stats = actor_step(params, rs, test_mode=False)
+                jax.block_until_ready(stats.epsilon)
+                tm_l = seb.to_learner(tm)
+                with cond:
+                    shared["q"] = queue_put(
+                        shared["q"],
+                        jnp.asarray(shared["put"] % sb.queue_slots,
+                                    jnp.int32), tm_l)
+                    shared["put"] += 1
+                    cond.notify_all()
+        except Exception as e:  # noqa: BLE001 — surfaced by the main leg
+            with cond:
+                shared["error"] = e
+                cond.notify_all()
+
+    with _REC.span("bench.measure", leg="sebulba-overlap"):
+        t0 = time.perf_counter()
+        th = threading.Thread(target=actor, daemon=True)
+        th.start()
+        for i in range(k):
+            with cond:
+                while shared["put"] <= i and shared["error"] is None:
+                    cond.wait()
+                if shared["error"] is not None:
+                    raise shared["error"]
+                ls, shared["q"] = queue_get(
+                    ls, shared["q"],
+                    jnp.asarray(i % sb.queue_slots, jnp.int32))
+            ls, info = learner_step(ls, jax.random.fold_in(key, i),
+                                    jnp.asarray(2000 + i))
+            with cond:
+                shared["params"] = seb.publish_params(
+                    ls.learner.params["agent"])
+                shared["consumed"] = i + 1
+                cond.notify_all()
+        _sync(info["loss"])
+        dt_overlap = time.perf_counter() - t0
+        th.join(timeout=30)
+    rate_overlap = env_steps / dt_overlap
+    speedup = rate_overlap / rate_serial
+    print(f"# sebulba A/B overlapped (1+1 devices, queue_slots="
+          f"{sb.queue_slots}, staleness={sb.staleness}): "
+          f"{dt_overlap * 1e3:.1f} ms -> {rate_overlap:,.0f} env-steps/s "
+          f"({speedup:.2f}x serialized)", file=sys.stderr)
+    print(json.dumps({
+        "metric": "env_steps_per_sec",
+        "value": round(rate_overlap, 1),
+        "unit": "env-steps/s/2-device-split",
+        # per-chip semantics like the DP record: the split uses 2 chips
+        "vs_baseline": round(rate_overlap / 2 / 50_000.0, 3),
+        "sebulba": {"actor_devices": 1, "learner_devices": 1,
+                    "queue_slots": sb.queue_slots,
+                    "staleness": sb.staleness},
+        # A/B pair: same split, same per-iteration work — serialized
+        # blocks every stage, overlapped is the production coordination
+        "serialized_env_steps_per_sec": round(rate_serial, 1),
+        "overlap_speedup": round(speedup, 3),
+        # context: the classic single-device async-chained loop (on a
+        # shared-core CPU host this can exceed both split legs — the
+        # split pays queue/copy overhead for compute overlap that only
+        # disjoint real chips can deliver)
+        "classic_env_steps_per_sec": round(rate_classic, 1),
+        "config": (None if args.smoke or args.envs or args.steps
+                   else args.config),
+        "n_envs": b,
+        "episode_steps": t_len,
+        "train_batch_episodes": bs,
+        "chained_iters": k,
+        "backend": jax.default_backend(),
+        "spans": _REC.summary(),
+    }))
+    return 0
+
+
 def bench_superstep(cfg, _time, args) -> int:
     """``--superstep K``: the dispatch-amortized training rate. ONE fused
     XLA program scans K rollout → in-place ring insert → (gated)
@@ -1093,6 +1308,15 @@ def main() -> int:
                          "with the mode in the record (spans summary is "
                          "cumulative across legs, like --all; per-mode "
                          "split via each span's leg= meta)")
+    ap.add_argument("--sebulba", action="store_true",
+                    help="measure the Sebulba decoupled actor/learner "
+                         "split (parallel/sebulba.py): overlapped "
+                         "rollout+train over a 1+1 device partition with "
+                         "the device-resident trajectory queue, vs the "
+                         "serialized single-device loop — one record "
+                         "with both rates and the overlap speedup "
+                         "(needs >= 2 devices; --smoke forces 2 CPU "
+                         "host devices)")
     ap.add_argument("--superstep", type=int, default=None, metavar="K",
                     help="measure the fused training superstep: ONE "
                          "program scanning K rollout->insert->train "
@@ -1141,6 +1365,25 @@ def main() -> int:
         if args.pipeline:
             ap.error("--superstep already amortizes dispatch inside one "
                      "program; drop --pipeline")
+    if args.sebulba:
+        if (args.all or args.hbm or args.prod_hbm or args.breakdown
+                or args.train or args.serve or args.superstep is not None
+                or args.kernels is not None or args.config == 5):
+            ap.error("--sebulba measures the decoupled actor/learner "
+                     "split; drop --all/--hbm/--prod-hbm/--breakdown/"
+                     "--train/--serve/--superstep/--kernels/--config 5")
+        if args.pipeline:
+            ap.error("--sebulba overlaps dispatch across the device "
+                     "split already; drop --pipeline")
+        # the split needs 2 devices; force 2 CPU host devices while jax
+        # is still unimported (no-op on hosts that already expose more —
+        # the flag only widens the CPU host platform)
+        if "jax" not in sys.modules:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + " --xla_force_host_platform_device_count=2").strip()
     if args.pipeline is not None and args.pipeline < 0:
         ap.error("--pipeline K must be >= 0")
     if args.pipeline and (args.hbm or args.breakdown or args.prod_hbm):
@@ -1157,7 +1400,8 @@ def main() -> int:
         measures_chain = not (args.smoke or args.hbm or args.breakdown
                               or args.prod_hbm or args.serve
                               or args.superstep is not None
-                              or args.kernels is not None)
+                              or args.kernels is not None
+                              or args.sebulba)
         args.pipeline = 4 if measures_chain else 0
 
     if args.smoke or args.hbm:
@@ -1295,6 +1539,15 @@ def main() -> int:
 
         with tracing():
             return bench_kernels(make_cfg_kernels, _time, args)
+
+    if args.sebulba:
+        if jax.device_count() < 2:
+            raise SystemExit(
+                "--sebulba needs >= 2 devices (a slice, or XLA_FLAGS="
+                "--xla_force_host_platform_device_count=2 "
+                "JAX_PLATFORMS=cpu)")
+        with tracing():
+            return bench_sebulba(cfg, _time, args)
 
     if args.superstep is not None:
         with tracing():

@@ -11,7 +11,7 @@ The default (lint) path is deliberately jax-free: pure AST, runs in
 front of every test batch, must not pay backend startup. ``--programs``
 is the opposite: it lowers (and for the donated hot programs compiles)
 the registered XLA programs on a tiny CPU config — it forces
-``JAX_PLATFORMS=cpu`` and a 2-CPU-device host platform so the audited
+``JAX_PLATFORMS=cpu`` and a 4-CPU-device host platform so the audited
 programs (and their checked-in fingerprints, ``analysis/programs.json``)
 are identical on every machine, TPU hosts included.
 """
@@ -32,18 +32,20 @@ from .graftlint import RULES, lint_package
 
 def _pin_cpu_platform() -> None:
     """Pin the audit to the canonical platform BEFORE jax initializes:
-    CPU backend, and at least the 2 host devices the dp program's fixed
-    mesh needs. The checked-in fingerprints/budgets are for exactly this
-    platform — auditing on whatever backend happens to be attached would
-    produce fiction. A no-op when jax is already imported (in-process
-    callers — the tests — own their platform)."""
+    CPU backend, and at least the 4 host devices the fixed audit meshes
+    need (the dp program's 2-device data mesh, and the sebulba
+    actor_step/learner_step programs' 2+2-device split). The checked-in
+    fingerprints/budgets are for exactly this platform — auditing on
+    whatever backend happens to be attached would produce fiction. A
+    no-op when jax is already imported (in-process callers — the tests
+    — own their platform)."""
     if "jax" in sys.modules:
         return
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=2").strip()
+            flags + " --xla_force_host_platform_device_count=4").strip()
 
 
 def _programs_main(args) -> int:

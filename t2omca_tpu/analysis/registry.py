@@ -183,11 +183,13 @@ def collect_default_programs() -> Registry:
     from ..kernels import attention as kernels_mod
     from ..learners import qmix_learner as learner_mod
     from ..parallel import mesh as mesh_mod
+    from ..parallel import sebulba as sebulba_mod
     from ..serve import program as serve_mod
 
     reg: Registry = {}
     ctx = audit_context()
-    for mod in (run_mod, mesh_mod, learner_mod, serve_mod, kernels_mod):
+    for mod in (run_mod, mesh_mod, sebulba_mod, learner_mod, serve_mod,
+                kernels_mod):
         hook = getattr(mod, "register_audit_programs", None)
         if hook is None:
             continue

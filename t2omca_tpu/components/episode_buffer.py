@@ -30,7 +30,7 @@ thousand episodes).
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -134,8 +134,14 @@ class BufferState:
     storage: EpisodeBatch       # arrays (capacity, T(+1), ...)
     insert_pos: jnp.ndarray     # () int32 — next ring slot
     episodes_in_buffer: jnp.ndarray  # () int32
-    priorities: jnp.ndarray     # (capacity,) float32 — p^alpha NOT pre-applied
-    max_priority: jnp.ndarray   # () float32 — running max, for new inserts
+    # (capacity,) float32 — stored PRE-EXPONENTIATED: p^alpha for the
+    # prioritized buffer (exponentiation happens once per priority WRITE
+    # — O(batch) at update, O(1) at insert — instead of over the full
+    # capacity on every sample; bit-identical probabilities, same op on
+    # the same inputs), raw p for the uniform buffer (which never
+    # samples by priority)
+    priorities: jnp.ndarray
+    max_priority: jnp.ndarray   # () float32 — running max of RAW priorities
 
 
 def _zeros_like_episode(n_agents: int, n_actions: int, obs_dim: int,
@@ -211,6 +217,12 @@ class ReplayBuffer:
                 f"batch_size_run")
         return (state.insert_pos + jnp.arange(b)) % self.capacity
 
+    def _insert_priority(self, state: BufferState) -> jnp.ndarray:
+        """STORED priority stamped on freshly inserted episodes: the raw
+        running max here; the prioritized subclass pre-exponentiates
+        (one scalar pow per insert — the storage convention)."""
+        return state.max_priority
+
     def _ring_advance(self, state: BufferState, storage: EpisodeBatch,
                       idx: jnp.ndarray, b: int) -> BufferState:
         """Post-insert bookkeeping shared by both insert paths: advance
@@ -222,7 +234,8 @@ class ReplayBuffer:
             insert_pos=(state.insert_pos + b) % self.capacity,
             episodes_in_buffer=jnp.minimum(
                 state.episodes_in_buffer + b, self.capacity),
-            priorities=state.priorities.at[idx].set(state.max_priority),
+            priorities=state.priorities.at[idx].set(
+                self._insert_priority(state)),
         )
 
     def insert_episode_batch(self, state: BufferState,
@@ -311,8 +324,10 @@ class ReplayBuffer:
         return self._gather(state, idx), idx, jnp.ones((batch_size,))
 
     def update_priorities(self, state: BufferState, idx: jnp.ndarray,
-                          priorities: jnp.ndarray) -> BufferState:
-        del idx, priorities
+                          priorities: jnp.ndarray,
+                          valid: Optional[jnp.ndarray] = None
+                          ) -> BufferState:
+        del idx, priorities, valid
         return state  # uniform: no-op
 
 
@@ -327,10 +342,18 @@ class PrioritizedReplayBuffer(ReplayBuffer):
     beta0: float = 0.4
     t_max: int = 1
 
+    def _insert_priority(self, state: BufferState) -> jnp.ndarray:
+        # storage convention: stored values are pre-exponentiated, so
+        # the fresh-episode stamp is max^alpha (one scalar pow per
+        # insert; bit-identical to exponentiating at sample time)
+        return state.max_priority ** self.alpha
+
     def _probs(self, state: BufferState) -> jnp.ndarray:
+        # stored values are ALREADY p^alpha (pre-exponentiated at
+        # insert/update — O(batch) writes), so sampling is a masked
+        # normalize instead of an O(capacity) pow every draw
         valid = jnp.arange(self.capacity) < state.episodes_in_buffer
-        p = jnp.where(valid, state.priorities, 0.0) ** self.alpha
-        p = jnp.where(valid, p, 0.0)
+        p = jnp.where(valid, state.priorities, 0.0)
         return p / jnp.maximum(p.sum(), 1e-12)
 
     def sample(self, state: BufferState, key: jax.Array, batch_size: int,
@@ -352,12 +375,28 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         return self._gather(state, idx), idx, w
 
     def update_priorities(self, state: BufferState, idx: jnp.ndarray,
-                          priorities: jnp.ndarray) -> BufferState:
-        """Feed |TD|+1e-6 back for the sampled episodes (Q9). Duplicate
-        indices resolve to one of the written values (XLA scatter), matching
-        the reference's last-write-wins dict update."""
-        pri = state.priorities.at[idx].set(priorities)
+                          priorities: jnp.ndarray,
+                          valid: Optional[jnp.ndarray] = None
+                          ) -> BufferState:
+        """Feed RAW |TD|+1e-6 back for the sampled episodes (Q9); the
+        stored form is pre-exponentiated (``p^alpha``, one O(batch) pow
+        here instead of O(capacity) per sample). Duplicate indices
+        resolve to one of the written values (XLA scatter), matching
+        the reference's last-write-wins dict update.
+
+        ``valid`` (optional () bool) is the non-finite guard seam: when
+        False the write degenerates to the episodes' EXISTING stored
+        values and the running max is untouched — value-identical to
+        not updating, with no host sync and no full-ring select (the
+        guard the driver used to inline; it moved here when the storage
+        went pre-exponentiated, so the fallback reads stored-space
+        values)."""
+        pa = priorities ** self.alpha
+        new_max = jnp.maximum(state.max_priority, priorities.max())
+        if valid is not None:
+            pa = jnp.where(valid, pa, state.priorities[idx])
+            new_max = jnp.where(valid, new_max, state.max_priority)
         return state.replace(
-            priorities=pri,
-            max_priority=jnp.maximum(state.max_priority, priorities.max()),
+            priorities=state.priorities.at[idx].set(pa),
+            max_priority=new_max,
         )

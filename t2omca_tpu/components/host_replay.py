@@ -79,9 +79,15 @@ class NativeSumTree:
             pri.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), len(idx))
 
     def get(self, idx):
-        idx = np.atleast_1d(idx)
-        return np.array([self._lib.sumtree_get(self._ptr, int(i))
-                         for i in idx])
+        # one batched ctypes crossing (native sumtree_get_batch) instead
+        # of a per-element Python loop over sumtree_get — the FFI call
+        # overhead dominated the old path at any realistic batch size
+        idx = np.ascontiguousarray(np.atleast_1d(idx), np.int64)
+        out = np.empty(len(idx), np.float64)
+        self._lib.sumtree_get_batch(
+            self._ptr, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(idx), out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        return out
 
     def total(self):
         return self._lib.sumtree_total(self._ptr)

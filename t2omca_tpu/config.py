@@ -244,6 +244,35 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class SebulbaConfig:
+    """Sebulba-style decoupled actor/learner (Podracer, PAPERS.md arXiv
+    2104.06272; ``parallel/sebulba.py``, docs/PERF.md). The visible
+    devices are partitioned into a disjoint actor set (runs the rollout)
+    and learner set (owns the replay ring and the train step), with a
+    bounded device-resident trajectory queue between them so both stay
+    saturated instead of idling through each other's phase. Off by
+    default (``actor_devices=0``): the driver is byte-identical to the
+    fused/classic loop and no compiled-program fingerprint changes."""
+
+    # disjoint device counts: devices[0:actor] act, the next `learner`
+    # devices train. Both 0 = disabled; both must be set together.
+    actor_devices: int = 0
+    learner_devices: int = 0
+    # trajectory-queue capacity in rollout batches (ring of slots on the
+    # learner devices). The actor blocks putting into a full queue; the
+    # learner blocks getting from an empty one. 1 + staleness=0 is the
+    # lockstep mode — bit-identical to the classic K=1 loop (pinned by
+    # tests/test_sebulba.py).
+    queue_slots: int = 2
+    # parameter-staleness bound: how many rollout batches the actor may
+    # run ahead of the learner's last processed batch. 0 = lockstep
+    # (every rollout waits for the params from the previous train step);
+    # S > 0 lets the actor act with params up to S learner updates old —
+    # the overlap that keeps both device sets busy.
+    staleness: int = 1
+
+
+@dataclass(frozen=True)
 class KernelsConfig:
     """Rollout hot-path kernel selection (``t2omca_tpu/kernels/``,
     docs/PERF.md). Every entry keeps the XLA lowering as the default
@@ -391,6 +420,7 @@ class TrainConfig:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     kernels: KernelsConfig = field(default_factory=KernelsConfig)
+    sebulba: SebulbaConfig = field(default_factory=SebulbaConfig)
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
@@ -525,6 +555,52 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
             "contradictory (same dead-knob policy as "
             "first_dispatch_timeout without dispatch_timeout); set "
             "obs.enabled=true too")
+    sb = cfg.sebulba
+    if (sb.actor_devices > 0) != (sb.learner_devices > 0):
+        raise ValueError(
+            f"sebulba.actor_devices and sebulba.learner_devices must be "
+            f"set together (both 0 disables the decoupled loop), got "
+            f"actor_devices={sb.actor_devices}, "
+            f"learner_devices={sb.learner_devices}")
+    if sb.actor_devices < 0 or sb.learner_devices < 0:
+        raise ValueError(
+            f"sebulba device counts must be >= 0, got "
+            f"actor_devices={sb.actor_devices}, "
+            f"learner_devices={sb.learner_devices}")
+    if sb.queue_slots < 1:
+        raise ValueError(f"sebulba.queue_slots must be >= 1, got "
+                         f"{sb.queue_slots}")
+    if sb.staleness < 0:
+        raise ValueError(f"sebulba.staleness must be >= 0 (0 = lockstep), "
+                         f"got {sb.staleness}")
+    if sb.actor_devices:
+        if cfg.replay.buffer_cpu_only:
+            raise ValueError(
+                "sebulba runs the replay ring + train step on the learner "
+                "device set; buffer_cpu_only keeps storage in host RAM — "
+                "pick one")
+        if cfg.dp_devices:
+            raise ValueError(
+                "sebulba partitions the visible devices itself (actor + "
+                "learner sets); it does not compose with dp_devices — "
+                "scale the actor set instead")
+        if cfg.superstep > 1:
+            raise ValueError(
+                "sebulba decouples rollout from training onto disjoint "
+                "device sets; the fused superstep re-serializes them into "
+                "one program — pick one (superstep=1 under sebulba)")
+        if cfg.batch_size_run % sb.actor_devices:
+            raise ValueError(
+                f"batch_size_run={cfg.batch_size_run} must be divisible "
+                f"by sebulba.actor_devices={sb.actor_devices} (env lanes "
+                f"shard over the actor mesh)")
+        if cfg.batch_size % sb.learner_devices \
+                or cfg.replay.buffer_size % sb.learner_devices:
+            raise ValueError(
+                f"batch_size={cfg.batch_size} and replay.buffer_size="
+                f"{cfg.replay.buffer_size} must be divisible by "
+                f"sebulba.learner_devices={sb.learner_devices} (replay "
+                f"episodes shard over the learner mesh)")
     if cfg.kernels.attention not in ("xla", "pallas"):
         raise ValueError(f"kernels.attention must be xla/pallas, got "
                          f"{cfg.kernels.attention!r}")
@@ -563,6 +639,7 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
     resilience_kw = dict(updates.pop("resilience", {}) or {})
     obs_kw = dict(updates.pop("obs", {}) or {})
     kernels_kw = dict(updates.pop("kernels", {}) or {})
+    sebulba_kw = dict(updates.pop("sebulba", {}) or {})
 
     # route flat keys to their sub-config for reference-style flat configs
     env_fields = {f.name for f in dataclasses.fields(EnvConfig)}
@@ -571,6 +648,7 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
     resilience_fields = {f.name for f in dataclasses.fields(ResilienceConfig)}
     obs_fields = {f.name for f in dataclasses.fields(ObsConfig)}
     kernels_fields = {f.name for f in dataclasses.fields(KernelsConfig)}
+    sebulba_fields = {f.name for f in dataclasses.fields(SebulbaConfig)}
     top_fields = {f.name for f in dataclasses.fields(TrainConfig)}
     flat = dict(updates)
     for k, v in flat.items():
@@ -594,6 +672,9 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
         elif k in kernels_fields:
             kernels_kw.setdefault(k, v)
             updates.pop(k)
+        elif k in sebulba_fields:
+            sebulba_kw.setdefault(k, v)
+            updates.pop(k)
         else:
             raise KeyError(f"unknown config key: {k}")
 
@@ -610,6 +691,8 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
         updates["obs"] = dataclasses.replace(cfg.obs, **obs_kw)
     if kernels_kw:
         updates["kernels"] = dataclasses.replace(cfg.kernels, **kernels_kw)
+    if sebulba_kw:
+        updates["sebulba"] = dataclasses.replace(cfg.sebulba, **sebulba_kw)
     return cfg.replace(**updates)
 
 

@@ -55,6 +55,9 @@ def load_sumtree() -> ctypes.CDLL:
     lib.sumtree_total.argtypes = [c.c_void_p]
     lib.sumtree_get.restype = c.c_double
     lib.sumtree_get.argtypes = [c.c_void_p, c.c_int64]
+    lib.sumtree_get_batch.argtypes = [
+        c.c_void_p, c.POINTER(c.c_int64), c.c_int64,
+        c.POINTER(c.c_double)]
     lib.sumtree_find.restype = c.c_int64
     lib.sumtree_find.argtypes = [c.c_void_p, c.c_double]
     lib.sumtree_sample.argtypes = [

@@ -57,6 +57,14 @@ double sumtree_get(const SumTree *t, int64_t idx) {
     return t->tree[t->cap + idx];
 }
 
+// Batched leaf read: one ctypes crossing for n leaves instead of the
+// Python-side one-call-per-element loop (the O(n) FFI overhead the
+// wrapper's old list comprehension paid on every priority readback).
+void sumtree_get_batch(const SumTree *t, const int64_t *idx, int64_t n,
+                       double *out) {
+    for (int64_t j = 0; j < n; ++j) out[j] = t->tree[t->cap + idx[j]];
+}
+
 // Descend from the root following the prefix sum `u` in [0, total).
 int64_t sumtree_find(const SumTree *t, double u) {
     int64_t i = 1;

@@ -48,6 +48,10 @@ PHASE_PROGRAMS = {
     # serving runs (serve/frontend.py): the dispatch span joins the
     # serve program's graftprog budgets on its own row
     "serve.dispatch": "serve_step",
+    # sebulba decoupled runs (run.run_sebulba): the re-homed rollout and
+    # train dispatches join their own audit entries (2+2-device split)
+    "actor.dispatch": "actor_step",
+    "learner.dispatch": "learner_step",
 }
 
 
@@ -128,9 +132,9 @@ def scale_factor(program: str, header: Optional[dict],
     try:
         b = float(header["batch_size_run"]) / audit["batch_size_run"]
         t = float(header["episode_limit"]) / audit["episode_limit"]
-        if program in ("rollout", "insert"):
+        if program in ("rollout", "insert", "actor_step"):
             return b * t
-        if program == "train_iter":
+        if program in ("train_iter", "learner_step"):
             return (float(header["batch_size"]) / audit["batch_size"]) * t
         if program == "superstep":
             k = float(header.get("superstep", 1)) / audit["superstep"]
@@ -245,7 +249,7 @@ def render(run_dir: str, events: List[dict], rows: List[dict],
     lines.append(f"events: {len(events)} ({n_spans} spans)")
     lines.append("")
     if rows:
-        hdr = (f"{'program':<11}{'phase':<20}{'n':>6}{'first ms':>10}"
+        hdr = (f"{'program':<13}{'phase':<20}{'n':>6}{'first ms':>10}"
                f"{'ms/disp':>10}{'src':>5}{'~GFLOP/d':>10}{'~GB/d':>8}"
                f"{'FLOP/B':>8}{'~GFLOP/s':>10}")
         lines.append(hdr)
@@ -253,7 +257,7 @@ def render(run_dir: str, events: List[dict], rows: List[dict],
         for r in rows:
             per_disp = r["per_disp_ms"]
             lines.append(
-                f"{r['program']:<11}{r['phase']:<20}{r['n']:>6}"
+                f"{r['program']:<13}{r['phase']:<20}{r['n']:>6}"
                 f"{_fmt(r['first_ms']):>10}{_fmt(per_disp):>10}"
                 f"{r.get('time_source', '-'):>5}"
                 f"{_fmt(r['gflop_disp'], 3):>10}{_fmt(r['gb_disp'], 3):>8}"
@@ -289,7 +293,66 @@ def render(run_dir: str, events: List[dict], rows: List[dict],
                 f"{ph:<22}{a['n']:>6}{_fmt(a['first_ms']):>10}"
                 f"{_fmt(mean):>10}{_fmt(a['max_ms']):>10}"
                 f"{_fmt(a['total_ms']):>11}{a['errors']:>7}")
+    seb = sebulba_utilization(events, phases)
+    if seb:
+        lines.append("")
+        lines.append("sebulba utilization (decoupled actor/learner run)")
+        hdr = (f"{'side':<9}{'busy ms':>12}{'idle ms':>12}{'util %':>8}")
+        lines.append(hdr)
+        lines.append("-" * len(hdr))
+        for side in ("actor", "learner"):
+            u = seb[side]
+            lines.append(f"{side:<9}{_fmt(u['busy_ms']):>12}"
+                         f"{_fmt(u['idle_ms']):>12}"
+                         f"{_fmt(u['util_pct']):>8}")
+        lines.append(f"queue depth (last log cadence): "
+                     f"{_fmt(seb.get('queue_depth'), 0)} "
+                     f"of {_fmt(seb.get('queue_slots'), 0)} slots")
+        lines.append("busy = dispatch span wall; idle = queue-wait span "
+                     "wall (put = actor backpressure, get = learner "
+                     "starvation); params.sync mixes the learner "
+                     "publish with the actor's staleness wait and is "
+                     "counted on neither side")
     return "\n".join(lines)
+
+
+def sebulba_utilization(events: List[dict],
+                        phases: Dict[str, dict]) -> Optional[dict]:
+    """Actor/learner utilization for a decoupled run, from the span
+    stream alone: each side's dispatch spans are its busy time and its
+    queue-end waits its idle time (``run.run_sebulba`` records the
+    waits inside the ``queue.put``/``queue.get`` spans). None when the
+    run has no sebulba phases (classic/fused runs keep their report
+    unchanged)."""
+    a = phases.get("actor.dispatch")
+    l = phases.get("learner.dispatch")
+    if a is None and l is None and "queue.put" not in phases:
+        return None
+    zero = {"total_ms": 0.0}
+
+    def util(busy, idle):
+        busy_ms = busy.get("total_ms", 0.0)
+        idle_ms = idle.get("total_ms", 0.0)
+        denom = busy_ms + idle_ms
+        return {"busy_ms": round(busy_ms, 1), "idle_ms": round(idle_ms, 1),
+                "util_pct": (round(100.0 * busy_ms / denom, 1)
+                             if denom > 0 else None)}
+
+    test = phases.get("dispatch.test", zero)
+    actor_busy = {"total_ms": (a or zero).get("total_ms", 0.0)
+                  + test.get("total_ms", 0.0)}
+    out = {"actor": util(actor_busy, phases.get("queue.put", zero)),
+           "learner": util(l or zero, phases.get("queue.get", zero))}
+    # queue depth / config from the run header + the last log-cadence
+    # sebulba mark (the driver emits one per log interval)
+    for ev in events:
+        if ev.get("event") != "mark":
+            continue
+        if ev.get("kind") == "run" and "queue_slots" in ev:
+            out["queue_slots"] = ev["queue_slots"]
+        if ev.get("kind") == "sebulba":
+            out["queue_depth"] = ev.get("queue_depth")
+    return out
 
 
 def report_main(run_dir: str, programs_json: Optional[str] = None,

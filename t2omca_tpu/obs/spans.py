@@ -76,6 +76,15 @@ KNOWN_PHASES = frozenset({
     # driver sync/fetch boundaries (run.py _sync_point via _watched)
     "dispatch.wait", "fetch.train_infos", "fetch.train_stats",
     "fetch.test_stats",
+    # sebulba decoupled-loop boundaries (run.run_sebulba,
+    # parallel/sebulba.py): actor-mesh rollout dispatch, the trajectory
+    # queue's two ends (put = actor-side d2d copy + slot scatter, its
+    # wait is backpressure = actor idle; get = learner-side slot gather
+    # + ring insert, its wait is starvation = learner idle), the
+    # learner-mesh train dispatch, and the staleness-bounded
+    # learner→actor parameter publish/adopt hop
+    "actor.dispatch", "queue.put", "queue.get", "learner.dispatch",
+    "params.sync",
     # checkpoint + startup boundaries
     "checkpoint.save", "collective.gather", "backend.init",
     # bench.py phases (bench harness spans; embedded in BENCH_r*.json)

@@ -1,4 +1,5 @@
 from .distributed import maybe_initialize_distributed
-from .mesh import DataParallel, make_mesh
+from .mesh import DataParallel, make_mesh, partition_devices
 
-__all__ = ["make_mesh", "DataParallel", "maybe_initialize_distributed"]
+__all__ = ["make_mesh", "partition_devices", "DataParallel",
+           "maybe_initialize_distributed"]

@@ -45,6 +45,27 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(np.asarray(devs).reshape(shape), axis_names)
 
 
+def partition_devices(n_actor: int, n_learner: int,
+                      devices: Optional[Sequence] = None
+                      ) -> tuple:
+    """Disjoint (actor, learner) device sets for the Sebulba decoupled
+    loop (``parallel/sebulba.py``): the first ``n_actor`` visible devices
+    act, the next ``n_learner`` train. Disjointness is the point — the
+    two meshes never contend for a chip, so rollout and training overlap
+    instead of serializing (Podracer's Sebulba split, PAPERS.md)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    need = n_actor + n_learner
+    if n_actor < 1 or n_learner < 1:
+        raise ValueError(f"actor/learner device counts must be >= 1, got "
+                         f"({n_actor}, {n_learner})")
+    if len(devs) < need:
+        raise ValueError(
+            f"sebulba needs {n_actor}+{n_learner}={need} devices, have "
+            f"{len(devs)} (hint: XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={need})")
+    return tuple(devs[:n_actor]), tuple(devs[n_actor:need])
+
+
 @dataclasses.dataclass(frozen=True)
 class DataParallel:
     """Sharded program wrapper for an ``Experiment`` (``run.Experiment``).

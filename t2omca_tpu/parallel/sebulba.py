@@ -1,0 +1,434 @@
+"""Sebulba-style decoupled actor/learner device partitioning (Podracer).
+
+The Anakin-style fused superstep (docs/SPEC.md §8) runs rollout and
+training *serialized on the same devices* — each phase idles the other,
+and the measured env-steps/s/chip caps well below the ROADMAP target.
+Podracer's Sebulba variant (PAPERS.md, arXiv 2104.06272) splits the
+visible devices into a disjoint **actor set** (runs the rollout) and
+**learner set** (owns the replay ring and the train step) with a bounded
+**device-resident trajectory queue** between them, so both stay
+saturated; EnvPool (arXiv 2206.10558) shows the same async-batching
+principle pays even at single-host scale. This module holds the device
+machinery; the driver loop lives in ``run.run_sebulba`` (host threads
+only orchestrate dispatches — every value stays on device).
+
+Pieces:
+
+* :func:`mesh.partition_devices` — the disjoint (actor, learner) split.
+* :class:`QueueState` — a ring of ``queue_slots`` trajectory slots on
+  the learner devices, each holding one rollout batch in the rollout
+  scan's TIME-MAJOR emission form (``TimeMajorEpisodes`` — never the
+  assembled ``(B, T+1, ...)`` episode batch). ``put`` is one scatter
+  per leaf into the slot axis; ``get`` gathers a slot and feeds it
+  straight to ``ReplayBuffer.insert_time_major`` (the PR 9 combined
+  ``(slot, t)`` index-map machinery — one scatter per leaf into the
+  ring), so an episode batch is never materialized anywhere on the
+  actor→queue→ring path.
+* :class:`LearnerSideState` — the learner-device half of the train
+  state (learner params/opt + replay ring + episode counter); the
+  runner state is the actor-device half. ``split``/``join`` convert to
+  and from the driver's checkpointable ``TrainState`` pytree.
+* :class:`Sebulba` — builds the per-mesh placements and the four jitted
+  programs (``_actor_step``, ``_queue_put``, ``_queue_get``,
+  ``_learner_step``) plus the learner→actor parameter publish (an async
+  device-to-device copy). Queue ordering/backpressure is host-side SPSC
+  bookkeeping (``run.run_sebulba``); device-side correctness needs no
+  locks because every queue/learner-state handle is threaded linearly
+  through donated programs — each dispatch consumes its predecessor's
+  output, so execution order is enforced by dataflow.
+
+Correctness anchor (ROADMAP item 2): the lockstep mode
+(``queue_slots=1, staleness=0``) is **bit-identical** to the classic
+K=1 three-program loop — same rollout definition (``run_raw``), a ring
+insert pinned bit-identical to ``insert_episode_batch`` (PR 9), the
+same sample→train→priority-feedback arithmetic and the same host-side
+key threading — pinned by tests/test_sebulba.py on forced multi-device
+CPU hosts (the DP test trick).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..components.episode_buffer import BufferState, TimeMajorEpisodes
+from ..learners.qmix_learner import LearnerState
+# one source for the weak_type-stripping invariant (run.py's chained-
+# output retrace guard); run.py imports nothing from parallel/ at module
+# level, so this is cycle-free
+from ..run import _strong
+
+
+@struct.dataclass
+class LearnerSideState:
+    """The learner-device half of ``run.TrainState`` (everything except
+    the actor-resident runner state): what the learner thread's consume
+    and train programs carry and donate."""
+
+    learner: LearnerState
+    buffer: BufferState
+    episode: jnp.ndarray        # () int32 — episodes consumed into the ring
+
+
+@struct.dataclass
+class QueueState:
+    """Bounded ring of trajectory slots on the learner devices. Leaves
+    are the rollout scan's time-major emission with a leading
+    ``(queue_slots,)`` axis. Which slots hold live data is host-side
+    SPSC bookkeeping (put/get counters in ``run.run_sebulba``) — the
+    device state is pure storage."""
+
+    slots: TimeMajorEpisodes    # leaves (S, T(+1 via last_*), B, ...)
+
+
+@dataclasses.dataclass(frozen=True)
+class Sebulba:
+    """Decoupled actor/learner programs for an ``Experiment``.
+
+    Usage (the ``run.run_sebulba`` shape)::
+
+        seb = Sebulba.build(exp, actor_devs, learner_devs, queue_slots)
+        rs, ls = seb.init_states(seed)       # born on their meshes
+        q = seb.init_queue()
+        params = seb.publish_params(ls.learner.params["agent"])
+        rs, tm, stats = seb.actor_step(params, rs)
+        q = seb.queue_put(q, slot, seb.to_learner(tm))
+        ls, q = seb.queue_get(ls, q, slot)   # gather slot -> ring insert
+        ls, info = seb.learner_step(ls, key, t_env)
+
+    Both device sets are 1-D ``data`` meshes: env lanes shard over the
+    actor mesh, replay episodes (and queue slots' batch axis) over the
+    learner mesh, params/scalars replicate — the same placement rules as
+    ``DataParallel``, applied per set. Size-1 sets reduce to plain
+    single-device placement, so the 1+1 smoke/lockstep configs pay no
+    SPMD machinery.
+    """
+
+    exp: object                 # run.Experiment (duck-typed, avoids cycle)
+    actor_mesh: Mesh
+    learner_mesh: Mesh
+    queue_slots: int
+    axis: str = "data"
+
+    @classmethod
+    def build(cls, exp, actor_devices: Sequence, learner_devices: Sequence,
+              queue_slots: int) -> "Sebulba":
+        if set(actor_devices) & set(learner_devices):
+            raise ValueError("actor and learner device sets must be "
+                             "disjoint — overlap re-serializes the phases "
+                             "the split exists to overlap")
+        if queue_slots < 1:
+            raise ValueError(f"queue_slots must be >= 1, got {queue_slots}")
+        cfg = exp.cfg
+        if cfg.batch_size_run % len(actor_devices):
+            raise ValueError(
+                f"batch_size_run={cfg.batch_size_run} must divide over "
+                f"{len(actor_devices)} actor devices")
+        if (cfg.batch_size % len(learner_devices)
+                or cfg.replay.buffer_size % len(learner_devices)):
+            raise ValueError(
+                f"batch_size={cfg.batch_size} and replay.buffer_size="
+                f"{cfg.replay.buffer_size} must divide over "
+                f"{len(learner_devices)} learner devices")
+        return cls(exp=exp,
+                   actor_mesh=Mesh(np.asarray(actor_devices), ("data",)),
+                   learner_mesh=Mesh(np.asarray(learner_devices),
+                                     ("data",)),
+                   queue_slots=int(queue_slots))
+
+    # ------------------------------------------------------------ shardings
+
+    def _sh(self, mesh: Mesh, *axes) -> NamedSharding:
+        return NamedSharding(mesh, P(*axes))
+
+    def runner_shardings(self, rs_like):
+        """Actor-mesh placement for the runner state: env lanes sharded,
+        key/t_env replicated, reward-scale per-ndim (the
+        ``DataParallel.state_shardings`` runner rules, on the actor
+        mesh)."""
+        lane = self._sh(self.actor_mesh, self.axis)
+        rep = self._sh(self.actor_mesh)
+        return rs_like.replace(
+            env_states=jax.tree.map(lambda _: lane, rs_like.env_states),
+            key=rep, t_env=rep,
+            rscale=jax.tree.map(
+                lambda x: lane if getattr(x, "ndim", 0) else rep,
+                rs_like.rscale))
+
+    def learner_shardings(self, ls_like):
+        """Learner-mesh placement: params/opt replicated (grads psum'd by
+        GSPMD when the loss averages over a sharded batch), replay
+        episodes sharded, PER bookkeeping replicated — the
+        ``DataParallel`` buffer rules, on the learner mesh."""
+        ep = self._sh(self.learner_mesh, self.axis)
+        rep = self._sh(self.learner_mesh)
+        buffer = ls_like.buffer.replace(
+            storage=jax.tree.map(lambda _: ep, ls_like.buffer.storage),
+            insert_pos=rep, episodes_in_buffer=rep,
+            priorities=rep, max_priority=rep)
+        return ls_like.replace(
+            learner=jax.tree.map(lambda _: rep, ls_like.learner),
+            buffer=buffer, episode=rep)
+
+    def tm_shardings(self, tm_like, mesh: Mesh, leading: int = 0):
+        """Placement for a ``TimeMajorEpisodes`` pytree (or the queue's
+        slot-stacked form with ``leading=1``): the batch axis shards
+        over ``mesh`` — axis ``leading+1`` for the time-major scan
+        leaves, axis ``leading`` for the bootstrap ``last_*`` leaves."""
+        seq = self._sh(mesh, *((None,) * (leading + 1)), self.axis)
+        last = self._sh(mesh, *((None,) * leading), self.axis)
+
+        def fill(subtree, s):
+            return jax.tree.map(lambda _: s, subtree)
+
+        return TimeMajorEpisodes(
+            obs=fill(tm_like.obs, seq),
+            state=fill(tm_like.state, seq),
+            avail_actions=fill(tm_like.avail_actions, seq),
+            actions=fill(tm_like.actions, seq),
+            reward=fill(tm_like.reward, seq),
+            terminated=fill(tm_like.terminated, seq),
+            last_obs=fill(tm_like.last_obs, last),
+            last_state=fill(tm_like.last_state, last),
+            last_avail=fill(tm_like.last_avail, last))
+
+    def params_sharding(self):
+        """Actor-mesh replication for the published acting params."""
+        return self._sh(self.actor_mesh)
+
+    # ------------------------------------------------------------ state
+
+    def _state_shapes(self, seed: int):
+        return jax.eval_shape(lambda: self.exp.init_train_state(seed))
+
+    def split_shapes(self, ts_like) -> Tuple[object, object]:
+        """(runner, learner-side) abstract halves of a TrainState."""
+        return ts_like.runner, LearnerSideState(
+            learner=ts_like.learner, buffer=ts_like.buffer,
+            episode=ts_like.episode)
+
+    def init_states(self, seed: int):
+        """Fresh (runner, learner-side) states BORN on their meshes —
+        two jitted builds with ``out_shardings`` (one per mesh; a single
+        program cannot output onto two disjoint device sets), so the
+        replay ring's zeros materialize as learner-mesh shards only and
+        no full-state single-device transient ever exists (the
+        ``DataParallel.init_sharded`` reasoning). Both builds run the
+        same deterministic ``init_train_state(seed)``, so the halves are
+        consistent."""
+        shapes = self._state_shapes(seed)
+        rs_shape, ls_shape = self.split_shapes(shapes)
+        rs = jax.jit(
+            lambda: self.exp.init_train_state(seed).runner,
+            out_shardings=self.runner_shardings(rs_shape))()
+        ls = jax.jit(
+            lambda: self.split_shapes(self.exp.init_train_state(seed))[1],
+            out_shardings=self.learner_shardings(ls_shape))()
+        return rs, ls
+
+    def place(self, ts) -> Tuple[object, object]:
+        """Place an EXISTING TrainState (the resume path) onto the two
+        meshes: runner half to the actor set, learner half to the
+        learner set (host→device copies; peak = old + new, like
+        ``DataParallel.shard``)."""
+        rs, ls = self.split_shapes(ts)
+        return (jax.device_put(rs, self.runner_shardings(rs)),
+                jax.device_put(ls, self.learner_shardings(ls)))
+
+    def join(self, rs, ls):
+        """Reassemble the driver's checkpointable TrainState pytree from
+        the two halves (device placement is irrelevant to the
+        checkpoint writer — it gathers to host per leaf)."""
+        from ..run import TrainState
+        return TrainState(learner=ls.learner, runner=rs,
+                          buffer=ls.buffer, episode=ls.episode)
+
+    def tm_abstract(self):
+        """eval_shape of the rollout scan's time-major emission (the
+        queue slot payload)."""
+        shapes = self._state_shapes(self.exp.cfg.seed)
+        params = shapes.learner.params["agent"]
+        _, tm, _ = jax.eval_shape(
+            lambda p, r: self.exp.runner.run_raw(p, r, test_mode=False),
+            params, shapes.runner)
+        return tm
+
+    def init_queue(self) -> QueueState:
+        """Zero-filled trajectory queue, born on the learner mesh."""
+        tm = self.tm_abstract()
+        slots_shape = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct((self.queue_slots,) + l.shape,
+                                           l.dtype), tm)
+        sh = QueueState(slots=self.tm_shardings(slots_shape,
+                                                self.learner_mesh,
+                                                leading=1))
+        return jax.jit(
+            lambda: QueueState(slots=jax.tree.map(
+                lambda l: jnp.zeros(l.shape, l.dtype), slots_shape)),
+            out_shardings=sh)()
+
+    # ------------------------------------------------------------ transfers
+
+    def to_learner(self, tm: TimeMajorEpisodes) -> TimeMajorEpisodes:
+        """Async device-to-device copy of a rollout emission from the
+        actor mesh to the learner mesh (the queue's ingress hop)."""
+        return jax.device_put(tm, self.tm_shardings(tm, self.learner_mesh))
+
+    def publish_params(self, agent_params):
+        """Async learner→actor copy of the acting params (replicated on
+        the actor mesh) — the ``params.sync`` hop. The caller bounds the
+        staleness window host-side (``sebulba.staleness``)."""
+        return jax.device_put(
+            agent_params,
+            jax.tree.map(lambda _: self.params_sharding(), agent_params))
+
+    # ------------------------------------------------------------ programs
+
+    def programs(self):
+        """→ (actor_step, queue_put, queue_get, learner_step) jitted.
+
+        * ``actor_step(params, rs, test_mode=False) -> (rs', tm, stats)``
+          — ``runner.run_raw`` on the actor mesh (the same single rollout
+          definition as the classic/fused paths).
+        * ``queue_put(q, slot, tm) -> q'`` (q donated) — one scatter per
+          leaf into the slot axis.
+        * ``queue_get(ls, q, slot) -> (ls', q)`` (both donated) — gather
+          the slot and scatter it straight into the replay ring via
+          ``insert_time_major`` (bit-identical to
+          ``insert_episode_batch(tm.to_batch())``), advancing the
+          episode counter. ``q`` passes through aliased, which threads
+          the queue handle linearly through puts and gets — device
+          execution order then follows host enqueue order by dataflow.
+        * ``learner_step(ls, key, t_env) -> (ls', info)`` (ls donated) —
+          the exact ``run.Experiment.jitted_programs._train_iter``
+          arithmetic (sample → train → non-finite-guarded priority
+          feedback) on the learner-side state.
+        """
+        exp = self.exp
+        runner, buffer, learner, cfg = (exp.runner, exp.buffer, exp.learner,
+                                        exp.cfg)
+        wsc = jax.lax.with_sharding_constraint
+        rs_c = lambda rs: self.runner_shardings(rs)
+        ls_c = lambda ls: self.learner_shardings(ls)
+        batch_sh = self._sh(self.learner_mesh, self.axis)
+
+        def _actor_step(params, rs, test_mode):
+            rs2, tm, stats = runner.run_raw(params, rs,
+                                            test_mode=test_mode)
+            rs2 = jax.tree.map(wsc, rs2, rs_c(rs2))
+            tm = jax.tree.map(wsc, tm, self.tm_shardings(
+                tm, self.actor_mesh))
+            return _strong(rs2), tm, stats
+
+        actor_step = jax.jit(_actor_step, static_argnames="test_mode")
+
+        def _queue_put(q: QueueState, slot, tm) -> QueueState:
+            slots = jax.tree.map(
+                lambda s, x: jax.lax.dynamic_update_index_in_dim(
+                    s, x.astype(s.dtype), slot, 0), q.slots, tm)
+            return QueueState(slots=jax.tree.map(
+                wsc, slots, self.tm_shardings(slots, self.learner_mesh,
+                                              leading=1)))
+
+        queue_put = jax.jit(_queue_put, donate_argnums=(0,))
+
+        def _queue_get(ls: LearnerSideState, q: QueueState, slot):
+            tm = jax.tree.map(
+                lambda s: jax.lax.dynamic_index_in_dim(s, slot, 0,
+                                                       keepdims=False),
+                q.slots)
+            buf = buffer.insert_time_major(ls.buffer, tm)
+            ls = ls.replace(buffer=buf,
+                            episode=ls.episode + cfg.batch_size_run)
+            return _strong(jax.tree.map(wsc, ls, ls_c(ls))), q
+
+        queue_get = jax.jit(_queue_get, donate_argnums=(0, 1))
+
+        def _learner_step(ls: LearnerSideState, key: jax.Array,
+                          t_env: jnp.ndarray):
+            # identical arithmetic + key threading to run._train_iter —
+            # the lockstep bit-parity anchor depends on it
+            k_sample, k_learn = jax.random.split(key)
+            batch, idx, weights = buffer.sample(
+                ls.buffer, k_sample, cfg.batch_size, t_env)
+            batch = jax.tree.map(lambda x: wsc(x, batch_sh), batch)
+            learner_state, info = learner.train(
+                ls.learner, batch, weights, t_env, ls.episode, k_learn)
+            buf = buffer.update_priorities(
+                ls.buffer, idx, info["td_errors_abs"] + 1e-6,      # Q9
+                valid=info["all_finite"])
+            ls = ls.replace(learner=learner_state, buffer=buf)
+            return _strong(jax.tree.map(wsc, ls, ls_c(ls))), info
+
+        learner_step = jax.jit(_learner_step, donate_argnums=(0,))
+        return actor_step, queue_put, queue_get, learner_step
+
+
+def make_sebulba(exp) -> Sebulba:
+    """Build the Sebulba machinery from ``exp.cfg.sebulba`` (the driver
+    entry): partition the visible devices into the configured disjoint
+    sets and size the queue."""
+    from .mesh import partition_devices
+    sb = exp.cfg.sebulba
+    actor, learner = partition_devices(sb.actor_devices, sb.learner_devices)
+    return Sebulba.build(exp, actor, learner, sb.queue_slots)
+
+
+#: the fixed audit split (2 actor + 2 learner devices) the registered
+#: ``actor_step``/``learner_step`` programs are lowered under — like
+#: ``mesh.AUDIT_MESH_DEVICES``, fixed so the checked-in fingerprints
+#: don't vary with the auditing host's device count
+AUDIT_SPLIT = (2, 2)
+
+
+def register_audit_programs(ctx):
+    """graftprog registry hook: the re-homed Sebulba hot programs under
+    the fixed 2+2-device split. ``actor_step`` is the rollout re-homed
+    onto the actor mesh; ``learner_step`` the sample→train→priority
+    program re-homed onto the learner mesh. Lowered-level only (like
+    ``dp_superstep`` — the SPMD compile is not worth the gate time).
+    Skipped, never failed, on hosts exposing fewer devices."""
+    from ..analysis.registry import AuditProgram
+    n_actor, n_learner = AUDIT_SPLIT
+    need = n_actor + n_learner
+    if len(jax.devices()) < need:
+        skip = AuditProgram.skipped(
+            f"needs >= {need} devices (hint: XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={need})")
+        return {"actor_step": skip, "learner_step": skip}
+    from .mesh import partition_devices
+    actor, learner = partition_devices(n_actor, n_learner)
+    seb = Sebulba.build(ctx.exp, actor, learner, queue_slots=2)
+    actor_step, _, _, learner_step = seb.programs()
+    rs_shape, ls_shape = seb.split_shapes(ctx.ts_shape)
+
+    def annotate(shapes, shardings):
+        return jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                               sharding=sh),
+            shapes, shardings)
+
+    agent_shape = ctx.ts_shape.learner.params["agent"]
+    params = annotate(
+        agent_shape,
+        jax.tree.map(lambda _: seb.params_sharding(), agent_shape))
+    rs = annotate(rs_shape, seb.runner_shardings(rs_shape))
+    ls = annotate(ls_shape, seb.learner_shardings(ls_shape))
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    t_env = jnp.asarray(0)          # weak-typed, like the driver's
+    return {
+        "actor_step": AuditProgram(
+            actor_step, (params, rs), kwargs=dict(test_mode=False),
+            description=f"sebulba rollout re-homed onto a {n_actor}-device "
+                        f"actor mesh (parallel/sebulba.py)"),
+        "learner_step": AuditProgram(
+            learner_step, (ls, key, t_env), donate_argnums=(0,),
+            description=f"sebulba sample->train->priority step re-homed "
+                        f"onto a {n_learner}-device learner mesh"),
+    }

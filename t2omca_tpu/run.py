@@ -68,6 +68,15 @@ def superstep_eligible(cfg: TrainConfig) -> bool:
     return cfg.superstep > 1 and not cfg.replay.buffer_cpu_only
 
 
+def sebulba_eligible(cfg: TrainConfig) -> bool:
+    """Whether the Sebulba decoupled actor/learner loop serves this
+    config (``parallel/sebulba.py``; the ``superstep_eligible``
+    predicate pattern): ``sebulba.actor_devices > 0`` opts in, and
+    ``sanity_check`` has already rejected the incompatible combinations
+    (host-RAM replay, dp_devices, superstep > 1)."""
+    return cfg.sebulba.actor_devices > 0
+
+
 def _strong(tree):
     """Drop weak_type from every chained output: the driver feeds these
     back as inputs, and a weak-typed leaf (e.g. from a Python-scalar
@@ -257,15 +266,14 @@ class Experiment:
             learner_state, info = learner.train(
                 ts.learner, constrain(batch), weights, t_env, ts.episode,
                 k_learn)
-            # non-finite guard: a tripped step must not scatter NaN
-            # priorities into the ring (they would win every PER draw
-            # forever) — write back the episodes' EXISTING priorities,
-            # value-identical to not updating, with no host sync and no
-            # full-ring select
-            prio = jnp.where(info["all_finite"],
-                             info["td_errors_abs"] + 1e-6,     # Q9
-                             ts.buffer.priorities[idx])
-            buf = buffer.update_priorities(ts.buffer, idx, prio)
+            # non-finite guard (valid=): a tripped step must not scatter
+            # NaN priorities into the ring (they would win every PER
+            # draw forever) — the buffer writes back the episodes'
+            # EXISTING stored values instead, value-identical to not
+            # updating, with no host sync and no full-ring select
+            buf = buffer.update_priorities(
+                ts.buffer, idx, info["td_errors_abs"] + 1e-6,      # Q9
+                valid=info["all_finite"])
             return _strong(ts.replace(learner=c_learner(learner_state),
                                       buffer=c_buffer(buf))), info
 
@@ -336,10 +344,9 @@ class Experiment:
             learner_state, info = learner.train(
                 ts.learner, constrain(batch), weights, t_env, ts.episode,
                 k_learn)
-            prio = jnp.where(info["all_finite"],
-                             info["td_errors_abs"] + 1e-6,     # Q9
-                             ts.buffer.priorities[idx])
-            buf = buffer.update_priorities(ts.buffer, idx, prio)
+            buf = buffer.update_priorities(
+                ts.buffer, idx, info["td_errors_abs"] + 1e-6,      # Q9
+                valid=info["all_finite"])
             return ts.replace(learner=c_learner(learner_state),
                               buffer=c_buffer(buf)), info
 
@@ -452,6 +459,12 @@ def run_sequential(exp: Experiment, logger: Logger,
     # tests, evaluate harnesses — get one from the config here)
     if rec is None:
         rec = obs_spans.make_recorder(cfg.obs, results_dir)
+    if sebulba_eligible(cfg):
+        # Sebulba decoupled actor/learner loop (docs/PERF.md): disjoint
+        # device meshes + device-resident trajectory queue; its own loop
+        # shape below — everything past this point is the fused/classic
+        # single-set driver
+        return run_sebulba(exp, logger, results_dir, rec=rec)
     env_info = exp.env.get_env_info()
     log.info(f"env_info: {env_info}")
 
@@ -1362,6 +1375,729 @@ def run_sequential(exp: Experiment, logger: Logger,
         log.info("Finished Training")
     rec.close()
     return ts
+
+
+def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
+                rec=None) -> TrainState:
+    """The Sebulba decoupled train loop (ROADMAP item 2, docs/PERF.md §
+    decoupled pipeline): rollout and training on DISJOINT device sets
+    with a bounded device-resident trajectory queue between them, so
+    neither phase idles the other's devices.
+
+    Two host threads orchestrate dispatches (no value ever comes to
+    host except at the same cadences the classic loop syncs at):
+
+    * the **actor thread** runs ``actor_step`` (the shared ``run_raw``
+      rollout definition) on the actor mesh, pushes each time-major
+      emission into the queue (``queue.put`` — an async device-to-device
+      copy + one scatter per leaf into the slot ring), adopts freshly
+      published params under the ``sebulba.staleness`` bound
+      (``params.sync``), and owns the test cadence (it owns the rollout
+      program and the runner state, exactly like the classic loop's
+      shared-runner test rollouts);
+    * the **learner (main) thread** consumes batches (``queue.get`` —
+      slot gather scattered straight into the replay ring via
+      ``insert_time_major``), mirrors the train gate host-side and
+      splits the key stream EXACTLY like the classic loop, trains
+      (``learner.dispatch``), publishes params back to the actor mesh,
+      and owns the log/save cadences, the non-finite escalation, the
+      degradation ladder and every exit path.
+
+    Failure routing: both threads route dispatches through the
+    watchdog-stamped retry helper (each thread has its OWN watchdog —
+    one armed stamp per instance); exhausted retries and actor-thread
+    failures land in the shared ladder, whose rungs here are restore
+    (tear down the actor thread, reload the newest checkpoint, restart
+    a fresh epoch) and abort — there is no superstep to degrade.
+    A stall on either side writes the diagnosis and trips the
+    ShutdownGuard, so a wedged learner dispatch still ends with the
+    actor thread exiting and a resumable checkpoint on disk
+    (tests/test_sebulba.py chaos scenario).
+
+    Lockstep mode (``queue_slots=1, staleness=0``) serializes
+    rollout→insert→train exactly like the classic K=1 loop and is
+    bit-identical to it (pinned by test on a forced multi-device CPU
+    host)."""
+    cfg = exp.cfg
+    sb = cfg.sebulba
+    log = logger.console_logger
+    if rec is None:
+        rec = obs_spans.make_recorder(cfg.obs, results_dir)
+    from .parallel.sebulba import make_sebulba
+    seb = make_sebulba(exp)
+    lockstep = sb.queue_slots == 1 and sb.staleness == 0
+    log.info(f"sebulba decoupled loop: {sb.actor_devices} actor + "
+             f"{sb.learner_devices} learner devices, queue_slots="
+             f"{sb.queue_slots}, staleness={sb.staleness}"
+             + (" (lockstep)" if lockstep else ""))
+
+    res = cfg.resilience
+    guard = (resilience.ShutdownGuard.install() if res.handle_signals
+             else resilience.ShutdownGuard())
+    model_dir = os.path.join(cfg.local_results_path, "models",
+                             os.path.basename(results_dir))
+    save_lock = threading.Lock()
+    spr = cfg.batch_size_run * cfg.env_args.episode_limit
+    n_test_runs = max(1, cfg.test_nepisode // cfg.batch_size_run)
+    test_quota = n_test_runs * cfg.batch_size_run
+    buffer_capacity = exp.buffer.capacity
+
+    actor_step, queue_put, queue_get, learner_step = seb.programs()
+
+    # ---- cross-thread cells (all access under `cond` unless noted) ----
+    cond = threading.Condition()
+    cell = {"rs": None,          # latest post-rollout runner state handle
+            "rs_t_env": 0,       # the actor's env-step cursor at it
+            "params": None,      # latest published acting params (actor mesh)
+            "version": 0,        # publish counter
+            "q": None}           # the queue handle (threaded linearly)
+    counters = {"put": 0, "got": 0, "consumed": 0, "started": 0}
+    idle = {"actor_s": 0.0, "learner_s": 0.0}   # cumulative blocked time
+    stop_event = threading.Event()   # epoch teardown (restore/exit)
+    actor_failure = []               # DispatchFailed escaped from the actor
+    dispatch_faults = 0
+    nonfinite_streak = 0
+    nonfinite_total = 0
+    restores = 0
+
+    # ---- resume target ------------------------------------------------
+    found = None
+    if cfg.checkpoint_path:
+        found = find_checkpoint(cfg.checkpoint_path, cfg.load_step)
+        if found is None:
+            log.info(f"no checkpoint found in {cfg.checkpoint_path}")
+
+    def _acquire_save_lock(where: str) -> bool:
+        """Bounded save-lock acquire (same contract as the classic
+        loop's): a wedged emergency save must not hang every later save
+        site."""
+        if save_lock.acquire(timeout=max(res.stall_grace_s, 60.0)):
+            return True
+        log.warning(f"{where}: checkpoint skipped — an emergency save "
+                    f"still holds the save lock (wedged backend?)")
+        return False
+
+    def _snapshot_state():
+        """The latest complete joined TrainState (for stamps and
+        saves): learner half from the main thread's handles, runner
+        half from the actor's published post-rollout handle."""
+        with cond:
+            rs = cell["rs"]
+        return seb.join(rs, state_cell["ls"]) if rs is not None else None
+
+    state_cell = {"ls": None}        # learner-side handle (main thread owns)
+
+    def _on_stall(diag: watchdog.StallDiagnosis) -> None:
+        """Learner-side stall response (same shape as the classic
+        loop's): diagnosis + flight tail, guard trip, then a bounded
+        emergency checkpoint from the stamped pre-dispatch state."""
+        extra = None
+        if rec.enabled:
+            try:
+                extra = {"recent_spans": rec.tail()}
+            except Exception:  # noqa: BLE001 — diagnostics only
+                log.exception("graftscope: flight tail unavailable")
+        watchdog.write_diagnosis(diag, model_dir, extra=extra)
+        guard.request("watchdog")
+        with cond:
+            cond.notify_all()        # wake any blocked queue wait
+        if (cfg.save_model and res.emergency_checkpoint
+                and jax.process_count() == 1
+                and not diag.phase.startswith("checkpoint")
+                and diag.state is not None
+                and watchdog.state_intact(diag.state)):
+            if not _acquire_save_lock("watchdog emergency save"):
+                return
+            try:
+                save_to = save_checkpoint(
+                    model_dir, diag.t_env, diag.state,
+                    gather_retries=res.dispatch_retries,
+                    gather_backoff_s=res.retry_backoff_s)
+                log.warning(f"watchdog: emergency checkpoint saved to "
+                            f"{save_to}")
+            except Exception as e:  # noqa: BLE001 — device may be wedged
+                log.warning(f"watchdog: emergency checkpoint failed "
+                            f"({e!r}); resume falls back to the last "
+                            f"cadence save")
+            finally:
+                save_lock.release()
+
+    def _on_actor_stall(diag: watchdog.StallDiagnosis) -> None:
+        """Actor-side stall response: diagnosis + guard trip only — the
+        learner (main) thread owns the checkpointable state and will
+        write the emergency save on its own exit path."""
+        extra = None
+        if rec.enabled:
+            try:
+                extra = {"recent_spans": rec.tail()}
+            except Exception:  # noqa: BLE001 — diagnostics only
+                pass
+        watchdog.write_diagnosis(diag, model_dir, extra=extra)
+        guard.request("watchdog-actor")
+        with cond:
+            cond.notify_all()
+
+    wd = wd_actor = None
+    if res.dispatch_timeout > 0:
+        wd = watchdog.Watchdog(
+            res.dispatch_timeout, on_stall=_on_stall,
+            grace_s=res.stall_grace_s, exit_code=res.stall_exit_code,
+            first_timeout_s=res.first_dispatch_timeout).start()
+        wd_actor = watchdog.Watchdog(
+            res.dispatch_timeout, on_stall=_on_actor_stall,
+            grace_s=res.stall_grace_s, exit_code=res.stall_exit_code,
+            first_timeout_s=res.first_dispatch_timeout).start()
+        log.info(f"dispatch watchdogs armed (actor + learner): timeout="
+                 f"{res.dispatch_timeout}s, grace={res.stall_grace_s}s")
+    ladder = watchdog.DegradationLadder(res.max_restores)
+
+    # ---- watched-dispatch helpers (both threads) ----------------------
+    def _watched(phase, state=None, awd=None, t=0, **meta):
+        """Watchdog stamp + span for one device-facing region; ``awd``
+        selects the calling thread's watchdog instance (one armed stamp
+        per instance — concurrent threads must not share one)."""
+        w = (awd.watch(phase, t_env=t, state=state)
+             if awd is not None else None)
+        if rec.enabled:
+            s = rec.span(phase, t_env=t, **meta)
+            return obs_spans.stacked(w, s) if w is not None else s
+        return w if w is not None else nullcontext()
+
+    def _dispatch(phase, fn, state, awd=None, t=0, retryable=True,
+                  **context):
+        """Fault-handled dispatch (the classic loop's ``_dispatch``
+        contract): hook + stamp + bounded in-place retry for transient
+        failures; exhaustion (or consumed donated state) raises
+        DispatchFailed for the ladder."""
+        nonlocal dispatch_faults
+        attempts = (1 + res.dispatch_retries) if retryable else 1
+        for attempt in range(1, attempts + 1):
+            try:
+                with _watched(phase, state, awd=awd, t=t, attempt=attempt,
+                              **context):
+                    resilience.fire(phase, t_env=t, attempt=attempt,
+                                    **context)
+                    return fn()
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not watchdog.is_transient(e):
+                    raise
+                dispatch_faults += 1
+                if attempt >= attempts or not watchdog.state_intact(state):
+                    raise watchdog.DispatchFailed(phase, attempt, e) from e
+                delay = watchdog.backoff_delay(attempt, res.retry_backoff_s)
+                log.warning(f"{phase}: transient dispatch failure "
+                            f"(attempt {attempt}/{attempts}), retrying "
+                            f"in {delay:.2f}s: {type(e).__name__}: {e}")
+                time.sleep(delay)
+
+    # ---- stat accumulators (actor pushes, both flush at cadences) -----
+    train_acc = StatsAccumulator()
+    test_acc = StatsAccumulator()
+
+    def _stopping() -> bool:
+        return stop_event.is_set() or guard.triggered
+
+    # ---- the actor thread body ----------------------------------------
+    def _actor_loop(rs, t_env0):
+        """Rollout producer: staleness-bounded params adoption → rollout
+        → queue put, plus the test cadence. Exits on quota, stop_event,
+        guard trip, or an escaped DispatchFailed (recorded for the main
+        thread's ladder)."""
+        a_t = t_env0
+        last_test_t = a_t - cfg.test_interval - 1
+        last_runner_log_t = t_env0
+        try:
+            while a_t <= cfg.t_max and not _stopping():
+                # params.sync: adopt the newest published params, but
+                # never act more than `staleness` batches ahead of the
+                # learner's last processed batch (0 = lockstep). Span
+                # only, no watchdog stamp: this wait is bounded by the
+                # LEARNER's progress, not device health — a slow train
+                # step must read as actor idle time, never as a stall
+                with _watched("params.sync", t=a_t):
+                    resilience.fire("params.sync", t_env=a_t)
+                    with cond:
+                        while (counters["started"] - counters["consumed"]
+                               > sb.staleness and not _stopping()):
+                            t0 = time.monotonic()
+                            cond.wait(0.05)
+                            idle["actor_s"] += time.monotonic() - t0
+                        params = cell["params"]
+                if _stopping():
+                    break
+
+                def _roll(rs=rs, params=params):
+                    rs2, tm, stats = actor_step(params, rs,
+                                                test_mode=False)
+                    # the actor thread's natural barrier: it has nothing
+                    # else to do, and blocking here makes actor.dispatch
+                    # spans the honest device rollout time
+                    jax.block_until_ready(stats.epsilon)  # graftlint: disable=GL105
+                    return rs2, tm, stats
+                rs, tm, stats = _dispatch("actor.dispatch", _roll, rs,
+                                          awd=wd_actor, t=a_t)
+                a_t += spr
+                with cond:
+                    counters["started"] += 1
+                    cell["rs"], cell["rs_t_env"] = rs, a_t
+                _dispatch("fetch.train_stats",
+                          lambda: train_acc.push(stats), None,
+                          awd=wd_actor, t=a_t, retryable=False)
+
+                # queue.put: wait for a free slot (backpressure), then
+                # d2d-copy the emission and scatter it into the slot
+                # ring. Span only (no stamp): a full queue is the
+                # learner being slower, i.e. actor idle — not a stall
+                with _watched("queue.put", t=a_t):
+                    resilience.fire("queue.put", t_env=a_t)
+                    tm_l = seb.to_learner(tm)
+                    with cond:
+                        while (counters["put"] - counters["got"]
+                               >= sb.queue_slots and not _stopping()):
+                            t0 = time.monotonic()
+                            cond.wait(0.05)
+                            idle["actor_s"] += time.monotonic() - t0
+                        if _stopping():
+                            break
+                        slot = counters["put"] % sb.queue_slots
+                        cell["q"] = queue_put(
+                            cell["q"], jnp.asarray(slot, jnp.int32), tm_l)
+                        counters["put"] += 1
+                        cond.notify_all()
+
+                # train-stat cadence (classic: runner_log_interval)
+                if a_t - last_runner_log_t >= cfg.runner_log_interval:
+                    def _flush_train_stats():
+                        train_acc.flush(logger, a_t)
+                        logger.log_stat("epsilon", train_acc.epsilon, a_t)
+                    _dispatch("fetch.train_stats", _flush_train_stats,
+                              None, awd=wd_actor, t=a_t, retryable=False)
+                    last_runner_log_t = a_t
+
+                # test cadence (the actor owns the rollout program and
+                # the runner state, like the classic loop's test rolls)
+                if (a_t - last_test_t) / cfg.test_interval >= 1.0:
+                    # drain the pipeline first and adopt the freshest
+                    # params: the classic loop evaluates AFTER the
+                    # current iteration's train step, so the test
+                    # rollouts here must see every produced batch
+                    # trained (lockstep bit-parity depends on it; for
+                    # overlapped configs it briefly drains the queue —
+                    # the same serialization the classic cadence pays)
+                    with _watched("params.sync", t=a_t):
+                        resilience.fire("params.sync", t_env=a_t)
+                        with cond:
+                            while (counters["consumed"]
+                                   < counters["started"]
+                                   and not _stopping()):
+                                t0 = time.monotonic()
+                                cond.wait(0.05)
+                                idle["actor_s"] += time.monotonic() - t0
+                            params = cell["params"]
+                    for _ in range(n_test_runs):
+                        if _stopping():
+                            break
+
+                        def _test_roll(rs=rs, params=params):
+                            rs2, _, s = actor_step(params, rs,
+                                                   test_mode=True)
+                            return rs2, s
+                        rs, s = _dispatch("dispatch.test", _test_roll,
+                                          rs, awd=wd_actor, t=a_t)
+                        _dispatch("fetch.test_stats",
+                                  lambda s=s: test_acc.push(s), None,
+                                  awd=wd_actor, t=a_t, retryable=False)
+                        if test_acc.n_episodes == test_quota:
+                            _dispatch(
+                                "fetch.test_stats",
+                                lambda: test_acc.flush(logger, a_t,
+                                                       prefix="test_"),
+                                None, awd=wd_actor, t=a_t,
+                                retryable=False)
+                    with cond:
+                        cell["rs"], cell["rs_t_env"] = rs, a_t
+                    last_test_t = a_t
+        except watchdog.DispatchFailed as df:
+            log.warning(f"actor thread: {df} — handing to the ladder")
+            with cond:
+                actor_failure.append(df)
+                cond.notify_all()
+        except Exception as e:  # noqa: BLE001 — surfaced to the ladder
+            log.exception("actor thread failed")
+            with cond:
+                actor_failure.append(
+                    watchdog.DispatchFailed("actor.dispatch", 1, e))
+                cond.notify_all()
+        finally:
+            with cond:
+                cond.notify_all()    # wake a learner waiting on the queue
+
+    # ---- state init / resume ------------------------------------------
+    key = jax.random.PRNGKey(cfg.seed + 1)
+    t_env = 0
+
+    def _place(found_):
+        """(rs, ls, t_env) freshly initialized or restored. The restore
+        streams each leaf STRAIGHT onto its mesh
+        (``load_checkpoint_sharded`` with an abstract eval_shape
+        template — per-leaf ``device_put``, so the two halves land on
+        their disjoint meshes with no full-state single-device
+        transient; the classic DP resume's ADVICE-r5 reasoning, which
+        matters doubly here because this is also the mid-run ladder
+        restore path, where the live sharded state still holds HBM)."""
+        if found_ is None:
+            return (*seb.init_states(cfg.seed), 0)
+        dirname, step = found_
+        shapes = jax.eval_shape(lambda: exp.init_train_state(cfg.seed))
+        rs_shape, ls_shape = seb.split_shapes(shapes)
+        ts = load_checkpoint_sharded(
+            dirname, shapes,
+            seb.join(seb.runner_shardings(rs_shape),
+                     seb.learner_shardings(ls_shape)),
+            verify=False)
+        rs, ls = seb.split_shapes(ts)
+        # keep the canonical placement for the restored cursor
+        rs = rs.replace(t_env=jax.device_put(
+            jnp.asarray(step, jnp.int32), rs.t_env.sharding))
+        log.info(f"resumed from {dirname} at t_env={step}")
+        return rs, ls, step
+
+    rs0, ls, t_env = _place(found)
+
+    if rec.enabled:
+        rec.mark("run", t_env=t_env, backend=jax.default_backend(),
+                 batch_size_run=cfg.batch_size_run,
+                 episode_limit=cfg.env_args.episode_limit,
+                 batch_size=cfg.batch_size, superstep=1,
+                 host_buffer=False, sebulba=True,
+                 actor_devices=sb.actor_devices,
+                 learner_devices=sb.learner_devices,
+                 queue_slots=sb.queue_slots, staleness=sb.staleness)
+
+    last_log_t = t_env
+    last_save_t = t_env if t_env else -cfg.save_model_interval - 1
+    start_time = time.time()
+    last_log_time = None
+    train_infos = []
+    episode = int(jax.device_get(ls.episode))  # graftlint: disable=GL105
+    buffer_filled = int(jax.device_get(       # graftlint: disable=GL105
+        ls.buffer.episodes_in_buffer))
+    state_cell["ls"] = ls
+
+    def _epoch(rs, t_env0):
+        """One actor-thread lifetime: spawn the producer, consume until
+        the quota is drained (or a guard trip / ladder rung ends it).
+        Returns ``'done' | 'failed'`` — 'failed' hands the recorded
+        DispatchFailed to the caller's ladder."""
+        nonlocal ls, t_env, episode, buffer_filled, key, train_infos
+        nonlocal nonfinite_streak, nonfinite_total, dispatch_faults
+        nonlocal last_log_t, last_save_t, last_log_time
+        stop_event.clear()
+        actor_failure.clear()
+        with cond:
+            counters.update(put=0, got=0, consumed=0, started=0)
+            cell["q"] = seb.init_queue()
+            cell["rs"], cell["rs_t_env"] = rs, t_env0
+            cell["params"] = seb.publish_params(ls.learner.params["agent"])
+            cell["version"] = 0
+        actor = threading.Thread(target=_actor_loop, args=(rs, t_env0),
+                                 daemon=True, name="t2omca-sebulba-actor")
+        actor.start()
+        failed = None
+        try:
+            while not guard.triggered:
+                resilience.fire("driver.iteration", t_env=t_env,
+                                guard=guard)
+                if guard.triggered:
+                    break
+                # queue.get: wait for an item (or producer exit), then
+                # gather the slot straight into the replay ring. Span
+                # only (no stamp): an empty queue is the actor being
+                # slower, i.e. learner idle — not a stall; the consume
+                # dispatch itself is an async enqueue whose faults
+                # surface at the stamped learner.dispatch/fetch
+                # boundaries
+                got_item = False
+                with _watched("queue.get", t=t_env):
+                    resilience.fire("queue.get", t_env=t_env)
+                    with cond:
+                        while (counters["put"] == counters["got"]
+                               and actor.is_alive() and not actor_failure
+                               and not _stopping()):
+                            t0 = time.monotonic()
+                            cond.wait(0.05)
+                            idle["learner_s"] += time.monotonic() - t0
+                        if actor_failure:
+                            failed = actor_failure[0]
+                            break
+                        if counters["put"] > counters["got"]:
+                            slot = counters["got"] % sb.queue_slots
+                            ls2, q2 = queue_get(
+                                ls, cell["q"],
+                                jnp.asarray(slot, jnp.int32))
+                            ls, cell["q"] = ls2, q2
+                            counters["got"] += 1
+                            got_item = True
+                            cond.notify_all()
+                if failed is not None or (not got_item):
+                    break               # producer finished (or failed)
+                state_cell["ls"] = ls
+                t_env += spr
+                episode += cfg.batch_size_run
+                buffer_filled = min(buffer_filled + cfg.batch_size_run,
+                                    buffer_capacity)
+
+                # train gate: the classic loop's host mirror + key split
+                if (buffer_filled >= cfg.batch_size
+                        and episode >= cfg.accumulated_episodes):
+                    key2, k_sample = jax.random.split(key)
+
+                    def _train_once(ls=ls, k_sample=k_sample):
+                        ls2, info = learner_step(ls, k_sample,
+                                                 jnp.asarray(t_env))
+                        return ls2, info
+                    ls, info = _dispatch("learner.dispatch", _train_once,
+                                         _snapshot_state(), awd=wd,
+                                         t=t_env)
+                    key = key2
+                    train_infos.append(info)
+                    state_cell["ls"] = ls
+
+                # params.sync: publish the (possibly) fresh params back
+                # to the actor mesh and advance the staleness window
+                # (an async device-to-device copy — the stamp bounds
+                # only the enqueue)
+                with _watched("params.sync", awd=wd, t=t_env):
+                    resilience.fire("params.sync", t_env=t_env)
+                    new_params = seb.publish_params(
+                        ls.learner.params["agent"])
+                with cond:
+                    cell["params"] = new_params
+                    cell["version"] += 1
+                    counters["consumed"] += 1
+                    cond.notify_all()
+
+                _cadences()
+            return ("failed", failed) if failed is not None else \
+                ("done", None)
+        except watchdog.DispatchFailed as df:
+            return "failed", df
+        finally:
+            stop_event.set()
+            with cond:
+                cond.notify_all()
+            actor.join(timeout=30.0)
+            if actor.is_alive():
+                log.warning("actor thread did not exit within 30s "
+                            "(wedged dispatch?) — continuing teardown; "
+                            "the daemon thread dies with the process")
+
+    def _cadences():
+        """Save + log cadences (learner thread; the actor owns the
+        test/runner-log cadences)."""
+        nonlocal last_save_t, last_log_t, last_log_time, train_infos
+        nonlocal nonfinite_streak, nonfinite_total
+        if cfg.save_model and (t_env - last_save_t) >= cfg.save_model_interval:
+            def _save_once():
+                with _watched("checkpoint.save", state_cell["ls"], awd=wd,
+                              t=t_env):
+                    if not _acquire_save_lock("save cadence"):
+                        return None
+                    try:
+                        return save_checkpoint(
+                            model_dir, t_env, _snapshot_state(),
+                            gather_retries=res.dispatch_retries,
+                            gather_backoff_s=res.retry_backoff_s)
+                    finally:
+                        save_lock.release()
+            save_to = watchdog.retry_call(
+                _save_once, attempts=1 + res.dispatch_retries,
+                backoff_s=res.retry_backoff_s, label="checkpoint.save")
+            if save_to is not None:
+                log.info(f"Saving models to {save_to}")
+                if res.keep_last:
+                    prune_checkpoints(model_dir, res.keep_last,
+                                      res.keep_every)
+                last_save_t = t_env
+
+        if (t_env - last_log_t) >= cfg.log_interval:
+            if train_infos:
+                def _fetch_infos():
+                    flags = np.asarray(jax.device_get(  # graftlint: disable=GL105
+                        [i["all_finite"] for i in train_infos]))
+                    return flags, jax.device_get(train_infos[-1])  # graftlint: disable=GL105
+                flags, last = _dispatch("fetch.train_infos",
+                                        _fetch_infos, None, awd=wd,
+                                        t=t_env, retryable=False)
+                for ok in flags:
+                    if ok:
+                        nonfinite_streak = 0
+                    else:
+                        nonfinite_streak += 1
+                        nonfinite_total += 1
+                if not flags.all():
+                    logger.log_stat("nonfinite_steps", nonfinite_total,
+                                    t_env)
+                    rec.mark("nonfinite", t_env=t_env,
+                             streak=nonfinite_streak,
+                             total=nonfinite_total)
+                    log.warning(
+                        f"non-finite loss/grads in "
+                        f"{int((~flags).sum())}/{len(flags)} train steps "
+                        f"since last log (streak={nonfinite_streak})")
+                for k in ("loss", "grad_norm", "td_error_abs",
+                          "q_taken_mean", "target_mean"):
+                    logger.log_stat(k, float(last[k]), t_env)
+                train_infos = []
+                if (res.nonfinite_tolerance
+                        and nonfinite_streak >= res.nonfinite_tolerance):
+                    raise _NonFiniteEscalation(nonfinite_streak)
+            with cond:
+                depth = counters["put"] - counters["got"]
+            logger.log_stat("queue_depth", depth, t_env)
+            logger.log_stat("actor_idle_s", round(idle["actor_s"], 3),
+                            t_env)
+            logger.log_stat("learner_idle_s",
+                            round(idle["learner_s"], 3), t_env)
+            if rec.enabled:
+                rec.mark("sebulba", t_env=t_env, queue_depth=depth,
+                         actor_idle_s=round(idle["actor_s"], 3),
+                         learner_idle_s=round(idle["learner_s"], 3))
+            if dispatch_faults:
+                logger.log_stat("dispatch_faults", dispatch_faults, t_env)
+            logger.log_stat("episode", episode, t_env)
+            now = time.time()
+            if last_log_time is not None:
+                logger.log_stat(
+                    "env_steps_per_sec",
+                    (t_env - last_log_t) / max(now - last_log_time, 1e-9),
+                    t_env)
+            last_log_time = now
+            logger.print_recent_stats()
+            last_log_t = t_env
+
+    # ---- epochs: run; a ladder restore reloads and re-enters ----------
+    try:
+        while True:
+            try:
+                status, failed = _epoch(rs0, t_env)
+            except _NonFiniteEscalation as nf:
+                status, failed = "failed", watchdog.DispatchFailed(
+                    "learner.dispatch", 1, nf)
+            if status == "done" or guard.triggered:
+                break
+            # ladder: no superstep to degrade — restore or abort
+            action = ladder.next_action(can_degrade=False)
+            logger.log_stat("dispatch_failures", ladder.failures, t_env)
+            rec.mark("ladder", action=action, phase=failed.phase,
+                     t_env=t_env, failures=ladder.failures)
+            good = (find_checkpoint(model_dir) if cfg.save_model
+                    else None)
+            if action == "restore" and good is not None:
+                log.warning(f"degradation ladder: {failed} — restoring "
+                            f"last good checkpoint {good[0]} "
+                            f"({ladder.describe()})")
+                rs0, ls, t_env = _place(good)
+                state_cell["ls"] = ls
+                episode = int(jax.device_get(ls.episode))  # graftlint: disable=GL105
+                buffer_filled = int(jax.device_get(       # graftlint: disable=GL105
+                    ls.buffer.episodes_in_buffer))
+                train_infos = []
+                nonfinite_streak = 0
+                fetches = train_acc.fetches
+                train_acc = StatsAccumulator()
+                train_acc.fetches = fetches
+                # the torn-down actor thread may have died mid-test-
+                # cadence: a partial accumulation would miss the
+                # exact-quota flush on every later cadence (the classic
+                # loop's test-failure reset, same reasoning)
+                tfetches = test_acc.fetches
+                test_acc = StatsAccumulator()
+                test_acc.fetches = tfetches
+                restores += 1
+                last_log_t = last_save_t = t_env
+                continue
+            rec.persist(os.path.join(model_dir, "flight_recorder.json"))
+            diag = wd.take_diagnosis() if wd is not None else None
+            raise RuntimeError(
+                f"sebulba dispatch failure exhausted the degradation "
+                f"ladder at t_env={t_env} ({ladder.describe()})"
+                + (f"; stall diagnosis: {diag.message()}" if diag else "")
+                + f" — last failure: {failed}") from failed
+    except BaseException as e:
+        rec.mark("crash", t_env=t_env,
+                 error=f"{type(e).__name__}: {e}"[:300])
+        rec.persist(os.path.join(results_dir, "flight_recorder.json"))
+        rec.close()
+        raise
+    finally:
+        stop_event.set()
+        with cond:
+            cond.notify_all()
+        if wd is not None:
+            wd.stop()
+        if wd_actor is not None:
+            wd_actor.stop()
+        guard.uninstall()
+
+    ts = _snapshot_state() or seb.join(rs0, ls)
+    if guard.triggered:
+        rec.mark("shutdown", t_env=t_env, signame=guard.signame or "")
+        rec.persist(os.path.join(results_dir, "flight_recorder.json"))
+        stall = (wd.take_diagnosis() if wd is not None else None) or \
+                (wd_actor.take_diagnosis() if wd_actor is not None
+                 else None)
+        if stall is not None:
+            log.warning(f"watchdog: {stall.message()} — diagnosis "
+                        f"persisted to {model_dir}/stall_diagnosis.json")
+        log.warning(f"shutdown requested ({guard.signame}) at "
+                    f"t_env={t_env} — stopping gracefully")
+        if cfg.save_model and res.emergency_checkpoint \
+                and watchdog.state_intact(ts):
+            if _acquire_save_lock("preemption exit"):
+                save_to = None
+                deadline = (watchdog.ExitDeadline(
+                                max(res.stall_grace_s, 60.0),
+                                res.stall_exit_code,
+                                label="sebulba exit emergency checkpoint")
+                            if wd is not None else nullcontext())
+                try:
+                    with deadline:
+                        save_to = watchdog.retry_call(
+                            lambda: save_checkpoint(
+                                model_dir, t_env, ts,
+                                gather_retries=res.dispatch_retries,
+                                gather_backoff_s=res.retry_backoff_s),
+                            attempts=1 + res.dispatch_retries,
+                            backoff_s=res.retry_backoff_s,
+                            label="checkpoint.emergency")
+                except Exception:  # noqa: BLE001 — exit stays orderly
+                    log.exception("emergency checkpoint failed on the "
+                                  "sebulba exit path")
+                finally:
+                    save_lock.release()
+                if save_to is not None:
+                    log.info(f"emergency checkpoint saved to {save_to}")
+        log.info(f"resume with checkpoint_path={model_dir} (newest valid "
+                 f"step selected automatically)")
+    else:
+        log.info("Finished Training")
+        log.info(f"sebulba totals: actor idle {idle['actor_s']:.2f}s, "
+                 f"learner idle {idle['learner_s']:.2f}s, "
+                 f"wall {time.time() - start_time:.2f}s")
+    rec.close()
+    return ts
+
+
+class _NonFiniteEscalation(RuntimeError):
+    """Internal control flow: the non-finite streak hit
+    ``resilience.nonfinite_tolerance`` inside the sebulba log cadence —
+    routed through the epoch ladder (restore rung) exactly like a
+    persistent dispatch failure."""
+
+    def __init__(self, streak: int):
+        super().__init__(f"training diverged: {streak} consecutive "
+                         f"non-finite train steps")
 
 
 def evaluate_sequential(exp: Experiment, logger: Logger,

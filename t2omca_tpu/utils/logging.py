@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 from collections import defaultdict
 from typing import Optional
 
@@ -47,6 +48,14 @@ class Logger:
                             if max_history is None else int(max_history))
         self._tb = None
         self._jsonl = None
+        # the sebulba driver logs from two threads (the actor thread's
+        # runner-log/test cadences, the learner's log cadence): a key
+        # inserted into self.stats while print_recent_stats iterates it
+        # is a RuntimeError out of the diagnostics layer, and two
+        # unsynchronized _jsonl writes can interleave mid-line — one
+        # uncontended lock covers both (single-thread drivers pay an
+        # uncontended acquire per cadence, not per step)
+        self._lock = threading.Lock()
 
     # ---- sinks -----------------------------------------------------------
     def setup_tb(self, dirname: str) -> None:
@@ -68,35 +77,40 @@ class Logger:
     # ---- scalar API ------------------------------------------------------
     def log_stat(self, key: str, value, t: int) -> None:
         value = float(value)
-        hist = self.stats[key]
-        hist.append((t, value))
-        if self.max_history and len(hist) > self.max_history:
-            # amortized trim: drop down to half the cap so the O(cap)
-            # del runs once per cap/2 appends, not on every append —
-            # but never below the 5 entries print_recent_stats reads
-            # (a cap of 5-9 must stay observationally identical to the
-            # unbounded behavior), and never above the cap itself
-            keep = min(max(self.max_history // 2, 5), self.max_history)
-            del hist[:len(hist) - keep]
-        if self._tb is not None:
-            self._tb.add_scalar(key, value, t)
-        if self._jsonl is not None:
-            self._jsonl.write(json.dumps(
-                {"key": key, "value": value, "t": t}) + "\n")
-            self._jsonl.flush()
+        with self._lock:
+            hist = self.stats[key]
+            hist.append((t, value))
+            if self.max_history and len(hist) > self.max_history:
+                # amortized trim: drop down to half the cap so the
+                # O(cap) del runs once per cap/2 appends, not on every
+                # append — but never below the 5 entries
+                # print_recent_stats reads (a cap of 5-9 must stay
+                # observationally identical to the unbounded behavior),
+                # and never above the cap itself
+                keep = min(max(self.max_history // 2, 5),
+                           self.max_history)
+                del hist[:len(hist) - keep]
+            if self._tb is not None:
+                self._tb.add_scalar(key, value, t)
+            if self._jsonl is not None:
+                self._jsonl.write(json.dumps(
+                    {"key": key, "value": value, "t": t}) + "\n")
+                self._jsonl.flush()
 
     def print_recent_stats(self) -> None:
         """Mirrors the reference's periodic stat dump
         (``per_run.py:283-286``): latest value per key at the newest t."""
-        if not self.stats:
-            return
-        t = max(ts[-1][0] for ts in self.stats.values())
-        items = [f"t_env: {t}"]
-        for k in sorted(self.stats):
-            window = self.stats[k][-5:]
-            mean = sum(v for _, v in window) / len(window)
-            items.append(f"{k}: {mean:.4f}")
-        self.console_logger.info("Recent stats | " + " | ".join(items))
+        with self._lock:
+            if not self.stats:
+                return
+            t = max(ts[-1][0] for ts in self.stats.values())
+            items = [f"t_env: {t}"]
+            for k in sorted(self.stats):
+                window = self.stats[k][-5:]
+                mean = sum(v for _, v in window) / len(window)
+                items.append(f"{k}: {mean:.4f}")
+            line = "Recent stats | " + " | ".join(items)
+        self.console_logger.info(line)
 
     def close(self) -> None:
         if self._tb is not None:

@@ -88,6 +88,26 @@ logger = logging.getLogger(__name__)
 #:       inside each retried jax.distributed.initialize attempt
 #:       (parallel/distributed.py) — raise a transient error to exercise
 #:       the init retry that de-flakes the gloo rendezvous.
+#:   ``actor.dispatch``      t_env=<int>, attempt=<int>
+#:       before EACH attempt of the sebulba actor thread's rollout
+#:       dispatch (run.run_sebulba) — sleep to simulate a wedged actor
+#:       mesh (the actor-side watchdog fires, trips the guard, and the
+#:       learner exits resumably); raise transient to exercise the
+#:       actor-side retry and the actor-failure→ladder handoff.
+#:   ``learner.dispatch``    t_env=<int>, attempt=<int>
+#:       same, for the sebulba learner thread's sample→train→priority
+#:       dispatch — sleep here for the wedged-learner chaos scenario
+#:       (watchdog fires while the actor thread exits resumably).
+#:   ``queue.put`` / ``queue.get``   t_env=<int>
+#:       at the trajectory queue's two ends (actor-side d2d copy + slot
+#:       scatter / learner-side slot gather + ring insert) — raise to
+#:       exercise the queue boundaries' failure surfacing. These wait
+#:       under backpressure/starvation by design, so they carry spans
+#:       but no watchdog stamp (a full/empty queue is idleness, not a
+#:       stall).
+#:   ``params.sync``         t_env=<int>
+#:       at the learner→actor parameter publish (learner side, stamped)
+#:       and the actor's staleness-bounded adopt wait (span only).
 _FAULTS: Dict[str, List[Callable]] = {}
 
 

@@ -428,9 +428,14 @@ def test_registry_names_and_structure():
     reg = collect_default_programs()
     assert set(reg) == {"rollout", "insert", "train_iter", "superstep",
                         "dp_superstep", "learner_train", "serve_step",
-                        "attn_xla", "attn_pallas"}
+                        "attn_xla", "attn_pallas",
+                        "actor_step", "learner_step"}
     # the donated hot programs are the compiled (memory-audited) ones
     assert reg["superstep"].compile and reg["train_iter"].compile
     assert reg["superstep"].donate_argnums == (0,)
-    # dp program exists on this host (conftest forces 8 CPU devices)
+    # mesh-bound programs exist on this host (conftest forces 8 CPU
+    # devices: enough for the dp 2-mesh and the sebulba 2+2 split)
     assert reg["dp_superstep"].skip is None
+    assert reg["actor_step"].skip is None
+    assert reg["learner_step"].skip is None
+    assert reg["learner_step"].donate_argnums == (0,)

@@ -50,6 +50,25 @@ def test_py_sumtree_matches_native():
     np.testing.assert_allclose(p_n, p_p)
 
 
+def test_native_sumtree_get_batch_matches_py():
+    """``NativeSumTree.get`` goes through ONE ctypes crossing
+    (``sumtree_get_batch``) instead of a per-element Python loop — exact
+    parity with ``PySumTree.get`` on every input shape the buffer uses
+    (scalar, array, duplicated + unordered indices)."""
+    nat = _native_or_skip(8)
+    py = PySumTree(8)
+    pri = np.array([0.5, 2.0, 0.0, 1.5, 3.0, 0.25, 7.0, 1.0])
+    nat.set_batch(np.arange(8), pri)
+    py.set_batch(np.arange(8), pri)
+    for idx in (3,                              # scalar
+                np.arange(8),                   # full sweep
+                np.array([7, 0, 3, 3, 6, 0])):  # unordered + dupes
+        got = nat.get(idx)
+        want = np.atleast_1d(py.get(np.atleast_1d(idx)))
+        np.testing.assert_array_equal(got, want)
+        assert got.dtype == np.float64
+
+
 # ------------------------------------------------------------------ buffer
 
 def _mk_batch(b, t=3, a=2, n_act=3, obs=4, state=5, seed=0):

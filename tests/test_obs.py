@@ -219,7 +219,10 @@ def test_every_driver_phase_is_registered():
                   "dispatch.train", "dispatch.test", "dispatch.wait",
                   "fetch.train_infos", "fetch.train_stats",
                   "fetch.test_stats", "collective.gather",
-                  "backend.init"):
+                  "backend.init",
+                  # sebulba decoupled-loop boundaries (run.run_sebulba)
+                  "actor.dispatch", "queue.put", "queue.get",
+                  "learner.dispatch", "params.sync"):
         assert phase in KNOWN_PHASES, phase
 
 
@@ -320,6 +323,54 @@ def test_report_cli_device_times_and_roofline(tmp_path, capsys):
     assert rc == 0
     assert "device" in out                    # device attribution used
     assert "roofline bound" in out and "%" in out
+
+
+def test_report_cli_sebulba_utilization_section(tmp_path, capsys):
+    """A decoupled run's report gains the actor/learner utilization
+    table (busy = dispatch spans, idle = queue-wait spans) and the last
+    queue-depth mark; classic runs (no sebulba phases) keep their
+    report unchanged."""
+    from t2omca_tpu.obs.__main__ import main
+    from t2omca_tpu.obs.report import sebulba_utilization
+    run_dir = tmp_path / "seb_run"
+    run_dir.mkdir()
+    events = [{"event": "mark", "kind": "run", "seq": 1, "t0": 0.0,
+               "backend": "cpu", "batch_size_run": 2, "episode_limit": 6,
+               "batch_size": 4, "superstep": 1, "queue_slots": 2,
+               "staleness": 1}]
+    seq = 2
+    for i in range(4):
+        for phase, ms in (("actor.dispatch", 60.0), ("queue.put", 20.0),
+                          ("queue.get", 30.0), ("learner.dispatch", 50.0),
+                          ("params.sync", 1.0)):
+            events.append({"event": "span", "seq": seq, "phase": phase,
+                           "t_env": 12 * i, "t0": float(i), "depth": 0,
+                           "wall_ms": ms, "outcome": "ok"})
+            seq += 1
+    events.append({"event": "mark", "kind": "sebulba", "seq": seq,
+                   "t0": 5.0, "t_env": 48, "queue_depth": 1,
+                   "actor_idle_s": 0.08, "learner_idle_s": 0.12})
+    with open(run_dir / "spans.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    rc = main(["report", str(run_dir)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "sebulba utilization" in out
+    assert "actor" in out and "learner" in out
+    assert "queue depth" in out
+    # the numbers behind the table: busy/(busy+idle) per side
+    u = sebulba_utilization(events, {
+        "actor.dispatch": {"total_ms": 240.0},
+        "queue.put": {"total_ms": 80.0},
+        "queue.get": {"total_ms": 120.0},
+        "learner.dispatch": {"total_ms": 200.0}})
+    assert u["actor"]["util_pct"] == 75.0      # 240/(240+80)
+    assert u["learner"]["util_pct"] == 62.5    # 200/(200+120)
+    assert u["queue_depth"] == 1 and u["queue_slots"] == 2
+    # classic runs: no section
+    assert sebulba_utilization(
+        [], {"dispatch.superstep": {"total_ms": 10.0}}) is None
 
 
 def test_report_cli_usage_errors(tmp_path, capsys):

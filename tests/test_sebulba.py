@@ -1,0 +1,361 @@
+"""Sebulba decoupled actor/learner loop (``run.run_sebulba``,
+``parallel/sebulba.py``, ``config.sebulba``): disjoint actor/learner
+device meshes with a device-resident trajectory queue.
+
+Pins the ROADMAP-item-2 contract: the lockstep mode (queue_slots=1,
+staleness=0) is BIT-identical to the classic K=1 three-program loop on
+a forced multi-device CPU host (the DP test trick), the queue's
+ring-of-slots wraparound is content-exact, backpressure bounds the
+in-flight batches at queue_slots, the staleness bound serializes the
+actor against the learner, and a wedged learner dispatch trips the
+watchdog while the actor thread exits resumably (the chaos scenario)."""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from t2omca_tpu.config import (EnvConfig, ModelConfig, ObsConfig,
+                               ReplayConfig, ResilienceConfig,
+                               SebulbaConfig, TrainConfig, sanity_check)
+from t2omca_tpu.run import Experiment, run_sequential, sebulba_eligible
+from t2omca_tpu.utils import resilience
+from t2omca_tpu.utils.checkpoint import find_checkpoint, verify_checkpoint
+from t2omca_tpu.utils.logging import Logger
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leaks():
+    resilience.clear_faults()
+    yield
+    resilience.clear_faults()
+
+
+def tiny_cfg(tmp_path=None, **kw):
+    """The test_superstep parity point (fast_norm off, dense storage)
+    at test scale."""
+    env_kw = kw.pop("env_kw", {})
+    replay_kw = kw.pop("replay_kw", {})
+    res_kw = kw.pop("res_kw", {})
+    seb_kw = kw.pop("seb_kw", None)
+    defaults = dict(
+        t_max=60, batch_size_run=2, batch_size=4, test_interval=1_000_000,
+        test_nepisode=2, log_interval=12, runner_log_interval=12,
+        save_model=False, save_model_interval=24,
+        epsilon_anneal_time=50,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=6, fast_norm=False, **env_kw),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1),
+        replay=ReplayConfig(buffer_size=8, **replay_kw),
+        resilience=ResilienceConfig(**res_kw),
+    )
+    if seb_kw is not None:
+        defaults["sebulba"] = SebulbaConfig(**seb_kw)
+    if tmp_path is not None:
+        defaults["local_results_path"] = str(tmp_path)
+    defaults.update(kw)
+    return sanity_check(TrainConfig(**defaults))
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y),
+                              equal_nan=True) for x, y in zip(la, lb))
+
+
+# ---------------------------------------------------------------- config
+
+def test_sebulba_config_sanity():
+    ok = tiny_cfg(seb_kw=dict(actor_devices=1, learner_devices=1))
+    assert sebulba_eligible(ok)
+    assert not sebulba_eligible(tiny_cfg())
+    with pytest.raises(ValueError, match="set together"):
+        tiny_cfg(seb_kw=dict(actor_devices=1, learner_devices=0))
+    with pytest.raises(ValueError, match="queue_slots"):
+        tiny_cfg(seb_kw=dict(actor_devices=1, learner_devices=1,
+                             queue_slots=0))
+    with pytest.raises(ValueError, match="staleness"):
+        tiny_cfg(seb_kw=dict(actor_devices=1, learner_devices=1,
+                             staleness=-1))
+    with pytest.raises(ValueError, match="buffer_cpu_only"):
+        tiny_cfg(seb_kw=dict(actor_devices=1, learner_devices=1),
+                 replay_kw=dict(buffer_cpu_only=True, prioritized=True))
+    with pytest.raises(ValueError, match="dp_devices"):
+        tiny_cfg(seb_kw=dict(actor_devices=1, learner_devices=1),
+                 dp_devices=2)
+    with pytest.raises(ValueError, match="superstep"):
+        tiny_cfg(seb_kw=dict(actor_devices=1, learner_devices=1),
+                 superstep=4)
+    with pytest.raises(ValueError, match="divisible"):
+        tiny_cfg(seb_kw=dict(actor_devices=3, learner_devices=1))
+
+
+def test_partition_devices_disjoint_and_bounded():
+    from t2omca_tpu.parallel.mesh import partition_devices
+    actor, learner = partition_devices(2, 2)
+    assert len(actor) == 2 and len(learner) == 2
+    assert not set(actor) & set(learner)
+    with pytest.raises(ValueError, match="hint"):
+        partition_devices(8, 8)
+    with pytest.raises(ValueError, match=">= 1"):
+        partition_devices(0, 2)
+
+
+# ---------------------------------------------------------------- lockstep
+
+def test_sebulba_lockstep_bit_identical_to_classic(tmp_path):
+    """THE correctness anchor (ROADMAP item 2 / acceptance criterion):
+    queue_slots=1 + staleness=0 on a 1+1 device split ends on EXACTLY
+    the classic K=1 loop's train state — params, opt state, replay ring
+    contents, PER priorities, runner state, episode counter — on the
+    conftest-forced multi-device CPU host. test_interval=24 makes the
+    test cadence fire MID-training (t_env 24, 48, ...) — the test
+    rollouts consume runner-state keys and must see exactly the
+    post-train params the classic loop's cadence sees, so a
+    stale-params test rollout breaks this equality."""
+    cfg_classic = tiny_cfg(tmp_path, test_interval=24)
+    cfg_seb = tiny_cfg(tmp_path, test_interval=24, seb_kw=dict(
+        actor_devices=1, learner_devices=1, queue_slots=1, staleness=0))
+    ts1 = run_sequential(Experiment.build(cfg_classic), Logger(),
+                         str(tmp_path / "classic"))
+    ts2 = run_sequential(Experiment.build(cfg_seb), Logger(),
+                         str(tmp_path / "sebulba"))
+    h1, h2 = jax.device_get(ts1), jax.device_get(ts2)
+    assert _leaves_equal(h1.learner, h2.learner)
+    assert _leaves_equal(h1.buffer, h2.buffer)
+    assert _leaves_equal(h1.runner, h2.runner)
+    assert _leaves_equal(h1.episode, h2.episode)
+
+
+# ---------------------------------------------------------------- queue
+
+def _machinery(queue_slots, **cfg_kw):
+    cfg = tiny_cfg(seb_kw=dict(actor_devices=1, learner_devices=1,
+                               queue_slots=queue_slots), **cfg_kw)
+    exp = Experiment.build(cfg)
+    from t2omca_tpu.parallel.sebulba import make_sebulba
+    seb = make_sebulba(exp)
+    return cfg, exp, seb
+
+
+def test_queue_wraparound_contents_match_direct_insert():
+    """5 rollout batches through a 2-slot queue (slots reused: 0,1,0,1,0)
+    must land in the replay ring exactly as direct ``insert_time_major``
+    calls would — slot reuse can never leak one batch's episodes into
+    another's ring slots, including across the ring's own wraparound
+    (capacity 8, 10 episodes inserted)."""
+    cfg, exp, seb = _machinery(queue_slots=2)
+    actor_step, queue_put, queue_get, _ = seb.programs()
+    rs, ls = seb.init_states(cfg.seed)
+    q = seb.init_queue()
+    params = seb.publish_params(ls.learner.params["agent"])
+
+    # reference: the same emissions inserted directly (no queue)
+    ref_buf = jax.device_get(ls.buffer)
+    ref_buf = jax.tree.map(jnp.asarray, ref_buf)
+    tms = []
+    rs_ref = rs
+    for _ in range(5):
+        rs_ref, tm, _ = actor_step(params, rs_ref, test_mode=False)
+        tms.append(tm)
+        ref_buf = exp.buffer.insert_time_major(ref_buf,
+                                               jax.device_get(tm))
+
+    # through the queue, slots cycling 0,1,0,1,0
+    for i, tm in enumerate(tms):
+        slot = jnp.asarray(i % 2, jnp.int32)
+        q = queue_put(q, slot, seb.to_learner(tm))
+        ls, q = queue_get(ls, q, slot)
+
+    got = jax.device_get(ls.buffer)
+    want = jax.device_get(ref_buf)
+    assert _leaves_equal(got.storage, want.storage)
+    assert int(got.insert_pos) == int(want.insert_pos)
+    assert int(got.episodes_in_buffer) == int(want.episodes_in_buffer)
+    np.testing.assert_array_equal(np.asarray(got.priorities),
+                                  np.asarray(want.priorities))
+
+
+@pytest.mark.slow   # threaded producer/consumer with real dispatches
+def test_queue_backpressure_bounds_inflight_batches():
+    """SPSC discipline: with a deliberately slow consumer the producer
+    must block at queue_slots in-flight batches (never overwrite an
+    unconsumed slot), and with a slow producer the consumer must block
+    at empty — every batch is produced and consumed exactly once."""
+    cfg, exp, seb = _machinery(queue_slots=2)
+    actor_step, queue_put, queue_get, _ = seb.programs()
+    rs, ls = seb.init_states(cfg.seed)
+    q = seb.init_queue()
+    params = seb.publish_params(ls.learner.params["agent"])
+    n = 6
+    cond = threading.Condition()
+    shared = {"q": q, "put": 0, "got": 0, "max_depth": 0, "error": None}
+
+    def producer(rs=rs):
+        try:
+            for i in range(n):
+                rs, tm, stats = actor_step(params, rs, test_mode=False)
+                jax.block_until_ready(stats.epsilon)
+                tm_l = seb.to_learner(tm)
+                with cond:
+                    while shared["put"] - shared["got"] >= 2:
+                        cond.wait(5.0)
+                    shared["q"] = queue_put(
+                        shared["q"],
+                        jnp.asarray(shared["put"] % 2, jnp.int32), tm_l)
+                    shared["put"] += 1
+                    shared["max_depth"] = max(shared["max_depth"],
+                                              shared["put"] - shared["got"])
+                    cond.notify_all()
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            with cond:
+                shared["error"] = e
+                cond.notify_all()
+
+    th = threading.Thread(target=producer, daemon=True)
+    th.start()
+    nonempty_waits = 0
+    for i in range(n):
+        time.sleep(0.15)                  # slow consumer: queue fills
+        with cond:
+            while shared["put"] <= i and shared["error"] is None:
+                nonempty_waits += 1
+                cond.wait(5.0)
+            assert shared["error"] is None, shared["error"]
+            ls, shared["q"] = queue_get(ls, shared["q"],
+                                        jnp.asarray(i % 2, jnp.int32))
+            shared["got"] = i + 1
+            cond.notify_all()
+    th.join(timeout=30)
+    assert not th.is_alive()
+    assert shared["put"] == shared["got"] == n
+    # the slow consumer made the producer hit (and respect) the bound
+    assert shared["max_depth"] == 2
+    assert int(jax.device_get(ls.buffer.episodes_in_buffer)) == \
+        min(n * cfg.batch_size_run, cfg.replay.buffer_size)
+
+
+# ---------------------------------------------------------------- staleness
+
+@pytest.mark.slow
+def test_staleness_bound_serializes_actor_against_learner(tmp_path):
+    """staleness=0 forbids rollout/train overlap: with a slowed learner
+    dispatch, no ``actor.dispatch`` span may overlap any
+    ``learner.dispatch`` span in time. staleness=2 on the same config
+    must overlap (that is what the knob buys) — both read from the
+    spans.jsonl telemetry of real driver runs."""
+    def spans_of(run_dir, phase):
+        out = []
+        with open(os.path.join(run_dir, "spans.jsonl")) as f:
+            for line in f:
+                ev = json.loads(line)
+                if ev.get("event") == "span" and ev.get("phase") == phase:
+                    t0 = float(ev["t0"])
+                    out.append((t0, t0 + ev["wall_ms"] / 1e3))
+        return out
+
+    def max_overlap_s(a_spans, b_spans):
+        """Largest pairwise interval overlap — thresholded by the
+        callers, because span t0 has millisecond resolution and its
+        wall clock is a different clock than perf_counter, so adjacent
+        intervals can spuriously 'overlap' by a few ms on a loaded
+        box (and genuine overlaps under the 0.3s learner sleep are
+        two orders of magnitude larger)."""
+        return max((min(a1, b1) - max(a0, b0)
+                    for a0, a1 in a_spans for b0, b1 in b_spans),
+                   default=0.0)
+
+    def run_with(staleness, name):
+        # slow BOTH phases (the hooks fire inside the spans): the tiny
+        # config's warm rollout is ~2 ms against a ~300 ms train, so
+        # without the actor-side sleep the overlap window is
+        # structurally microscopic even when overlap is allowed — with
+        # both sides at hundreds of ms, allowed overlap is macroscopic
+        # and forbidden overlap stays zero
+        resilience.clear_faults()
+        resilience.register_fault(
+            "actor.dispatch", lambda **kw: time.sleep(0.25))
+        resilience.register_fault(
+            "learner.dispatch", lambda **kw: time.sleep(0.2))
+        cfg = tiny_cfg(tmp_path, t_max=120,
+                       obs=ObsConfig(enabled=True),
+                       seb_kw=dict(actor_devices=1, learner_devices=1,
+                                   queue_slots=4, staleness=staleness))
+        run_dir = str(tmp_path / name)
+        run_sequential(Experiment.build(cfg), Logger(), run_dir)
+        return (spans_of(run_dir, "actor.dispatch"),
+                spans_of(run_dir, "learner.dispatch"))
+
+    actor0, learner0 = run_with(0, "lockstep")
+    assert actor0 and learner0
+    assert max_overlap_s(actor0, learner0) < 0.025, \
+        "staleness=0 must serialize rollouts against train dispatches"
+    actor2, learner2 = run_with(2, "overlapped")
+    assert actor2 and learner2
+    assert max_overlap_s(actor2, learner2) > 0.05, \
+        "staleness=2 with a slow learner must overlap the phases"
+
+
+# ---------------------------------------------------------------- chaos
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.faultinject
+def test_chaos_wedged_learner_trips_watchdog_actor_exits_resumable(
+        tmp_path):
+    """The tentpole's chaos scenario: a wedged learner dispatch (well
+    past ``dispatch_timeout``) fires the watchdog — stall diagnosis on
+    disk, guard tripped — while the ACTOR thread exits cleanly, and the
+    run ends RESUMABLE: a verified checkpoint exists and a fresh
+    fault-free driver resumes it to t_max."""
+    hang = {"fired": False}
+
+    def wedge(t_env=0, attempt=1, **kw):
+        # one wedge, after the phase is warm (the compile exemption
+        # means the FIRST occurrence is unbounded by design)
+        if t_env >= 36 and not hang["fired"]:
+            hang["fired"] = True
+            time.sleep(3.0)
+
+    resilience.register_fault("learner.dispatch", wedge)
+    cfg = tiny_cfg(
+        tmp_path, t_max=120, save_model=True, save_model_interval=12,
+        seb_kw=dict(actor_devices=1, learner_devices=1, queue_slots=2,
+                    staleness=1),
+        res_kw=dict(dispatch_timeout=0.75, stall_grace_s=0.0,
+                    emergency_checkpoint=True))
+    run_sequential(Experiment.build(cfg), Logger(), str(tmp_path / "r"))
+    assert hang["fired"]
+
+    # the watchdog fired and left its diagnosis
+    model_dirs = glob.glob(os.path.join(str(tmp_path), "models", "*"))
+    assert model_dirs
+    diag_path = os.path.join(model_dirs[0], "stall_diagnosis.json")
+    assert os.path.exists(diag_path)
+    with open(diag_path) as f:
+        diag = json.load(f)
+    assert diag["phase"] == "learner.dispatch"
+
+    # the actor thread exited (no lingering producer)
+    assert not any(t.name == "t2omca-sebulba-actor" and t.is_alive()
+                   for t in threading.enumerate())
+
+    # resumable: a verified checkpoint + a fault-free resume to t_max
+    found = find_checkpoint(model_dirs[0])
+    assert found is not None
+    assert verify_checkpoint(found[0])
+    resilience.clear_faults()
+    cfg2 = cfg.replace(checkpoint_path=model_dirs[0],
+                       resilience=ResilienceConfig())
+    ts = run_sequential(Experiment.build(cfg2), Logger(),
+                        str(tmp_path / "resume"))
+    assert int(jax.device_get(ts.episode)) > 0
+    assert int(jax.device_get(ts.runner.t_env)) >= 0  # completed cleanly

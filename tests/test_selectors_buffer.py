@@ -197,6 +197,86 @@ def test_per_weights_ignore_zero_priority_tail_slots():
     assert seen == {0, 1, 2}
 
 
+def test_per_preexponentiated_storage_bit_matches_sample_time_pow():
+    """PR 10 satellite: priorities are stored PRE-EXPONENTIATED
+    (``p^alpha`` computed once per write) instead of re-computing
+    ``priorities ** alpha`` over the full capacity on every sample. The
+    sampled indices AND importance weights must be BIT-identical to the
+    old formulation (same op on the same raw inputs, just moved from
+    the sample path to the write path) — pinned here by re-implementing
+    the pre-change sample over the raw priorities."""
+    alpha, beta0, t_max, cap = 0.6, 0.4, 100, 8
+    buf = _buf(PrioritizedReplayBuffer, cap=cap, alpha=alpha, beta0=beta0,
+               t_max=t_max)
+    s = buf.insert_episode_batch(buf.init(), _make_batch(5))
+    raw = np.zeros(cap, np.float32)
+    raw[:5] = 1.0                       # fresh stamp = raw running max
+    s = buf.update_priorities(s, jnp.arange(3),
+                              jnp.asarray([3.0, 0.5, 2.0]))
+    raw[:3] = [3.0, 0.5, 2.0]
+    # storage convention: stored == raw ** alpha, exactly
+    np.testing.assert_array_equal(
+        np.asarray(s.priorities),
+        np.where(np.arange(cap) < 5,
+                 jnp.asarray(raw) ** jnp.float32(alpha), 0.0))
+
+    def old_sample(key, batch_size, t_env):
+        """The pre-change formulation, verbatim: exponentiate the RAW
+        priorities inside the sample."""
+        n = s.episodes_in_buffer
+        valid = jnp.arange(cap) < n
+        p = jnp.where(valid, jnp.asarray(raw), 0.0) ** alpha
+        p = jnp.where(valid, p, 0.0)
+        probs = p / jnp.maximum(p.sum(), 1e-12)
+        cdf = jnp.cumsum(probs)
+        u = (jnp.arange(batch_size)
+             + jax.random.uniform(key, (batch_size,))) / batch_size
+        idx = jnp.searchsorted(cdf, u * cdf[-1], side="left")
+        idx = jnp.clip(idx, 0, cap - 1)
+        beta = beta0 + (1.0 - beta0) * jnp.clip(
+            jnp.asarray(t_env, jnp.float32) / t_max, 0.0, 1.0)
+        nf = jnp.maximum(n, 1).astype(jnp.float32)
+        w = (nf * jnp.maximum(probs[idx], 1e-12)) ** (-beta)
+        return idx, w / jnp.maximum(w.max(), 1e-12)
+
+    for i in range(8):
+        for t_env in (0, 37, 100):
+            key = jax.random.PRNGKey(i)
+            _, idx, w = buf.sample(s, key, 4, t_env=t_env)
+            idx_old, w_old = old_sample(key, 4, t_env)
+            np.testing.assert_array_equal(np.asarray(idx),
+                                          np.asarray(idx_old))
+            np.testing.assert_array_equal(np.asarray(w),
+                                          np.asarray(w_old))
+
+
+def test_per_update_priorities_valid_guard_is_noop_in_value():
+    """The non-finite guard moved into ``update_priorities(valid=)``:
+    valid=False must leave stored priorities AND the raw running max
+    bit-identical to not updating at all (the driver's old inline
+    ``jnp.where`` fallback, now in stored space)."""
+    buf = _buf(PrioritizedReplayBuffer, cap=4, alpha=0.6, beta0=0.4,
+               t_max=100)
+    s = buf.insert_episode_batch(buf.init(), _make_batch(4))
+    s = buf.update_priorities(s, jnp.arange(4),
+                              jnp.asarray([1.0, 2.0, 3.0, 4.0]))
+    tripped = buf.update_priorities(
+        s, jnp.asarray([0, 2]), jnp.asarray([np.nan, 99.0]),
+        valid=jnp.asarray(False))
+    np.testing.assert_array_equal(np.asarray(tripped.priorities),
+                                  np.asarray(s.priorities))
+    assert float(tripped.max_priority) == float(s.max_priority)
+    # and valid=True is exactly the unguarded update
+    ok = buf.update_priorities(s, jnp.asarray([0, 2]),
+                               jnp.asarray([5.0, 9.0]),
+                               valid=jnp.asarray(True))
+    plain = buf.update_priorities(s, jnp.asarray([0, 2]),
+                                  jnp.asarray([5.0, 9.0]))
+    np.testing.assert_array_equal(np.asarray(ok.priorities),
+                                  np.asarray(plain.priorities))
+    assert float(ok.max_priority) == float(plain.max_priority) == 9.0
+
+
 def test_per_new_episodes_get_max_priority():
     buf = _buf(PrioritizedReplayBuffer, cap=4, alpha=1.0, beta0=0.4,
                t_max=100)
