@@ -38,68 +38,172 @@ from t2omca_tpu.obs.spans import SpanRecorder
 #: diagnosable instead of a bare "backend init" death (BENCH_r03–r05).
 _REC = SpanRecorder(ring_size=128)
 
+#: keys merged into every emitted success record (``_finalize``): the
+#: probe's fallback-continue path tags records with the backend they
+#: actually ran on, so a ``T2OMCA_BENCH_FALLBACK=1`` CPU number can
+#: never masquerade as the pinned platform's
+_RECORD_EXTRA: dict = {}
 
-def probe_backend(probe_s: float, _cmd=None) -> "dict | None":
-    """Bounded backend-init probe in a SUBPROCESS, one retry. A wedged
-    axon tunnel blocks ``jax.devices()`` ~25 min inside backend init
-    (BASELINE.md) — longer than most callers' own timeouts — and a
+
+def _finalize(rec: dict) -> dict:
+    """Attach the per-phase span summary + any record-wide tags
+    (platform fallback) to a bench record before emission."""
+    rec.setdefault("spans", _REC.summary())
+    rec.update(_RECORD_EXTRA)
+    return rec
+
+
+class _ProbeTimeout(RuntimeError):
+    """Probe attempt hit its slice of the budget (wedged-tunnel shape)."""
+
+
+class _ProbeBackendError(RuntimeError):
+    """Probe child ran and failed (real backend error, stderr attached)."""
+
+
+def probe_backend(probe_s: float, _cmd=None, attempts: "int | None" = None,
+                  _sleep=time.sleep) -> "dict | None":
+    """Bounded backend-init probe in a SUBPROCESS, as a RETRYABLE phase
+    on the resilience retry ladder (ROADMAP item 1 / ISSUE 11): attempts
+    come from ``T2OMCA_BACKEND_PROBE_RETRIES`` (retries beyond the
+    first, default 1 — the dispatch_retries convention), backoff between
+    attempts from ``utils.watchdog.retry_call``'s exponential+jitter
+    ladder (base ``T2OMCA_BACKEND_PROBE_BACKOFF``, default 0.5 s). A
+    wedged axon tunnel blocks ``jax.devices()`` ~25 min inside backend
+    init (BASELINE.md) — longer than most callers' own timeouts — and a
     blocked in-process thread can never be joined, so the probe runs
     ``jax.devices()`` in a child process the parent can kill at the
     bound. Returns ``None`` on success, else a structured
     ``{"error", "phase"}`` dict for the failure record — ``phase`` is
     ``"timeout"`` when the bound fired (the wedged-tunnel shape) and
     ``"backend_init"`` when the child itself failed (backend error with
-    a real stderr). A healthy init is seconds; the bound only fires on a
-    dead tunnel, where no claim is held yet, so killing the child cannot
-    wedge the remote further.
+    a real stderr).
+
+    The budget is TOTAL: each attempt gets an equal split of whatever
+    remains of ``probe_s`` (backoff sleeps spend budget too), so adding
+    retries never pushes the error record past a caller's own timeout —
+    recreating the no-record-on-stdout failure this probe exists to
+    prevent.
 
     The child is spawned via ``Popen`` so the timeout path OWNS the
     cleanup: kill + ``wait`` in a ``finally``, guaranteeing the child is
     dead AND reaped (no zombie accumulating against the caller's pid
     limit — a soak loop hitting a wedged tunnel would otherwise leak one
-    defunct process per probe). ``_cmd`` overrides the probed command for
-    tests (a sleeping child stands in for the wedged init).
+    defunct process per probe). ``_cmd`` overrides the probed command and
+    ``_sleep`` the backoff sleeper for tests.
 
     Deliberate cost: the child's backend init is thrown away, so a
     healthy run initializes twice (seconds on CPU/local TPU). That buys
     a killable probe — the previous in-process thread could never be
-    joined once wedged and had to ``os._exit`` the whole bench — plus
-    the retry, which distinguishes a transient tunnel blip from a wedge
-    before any measurement time is spent."""
+    joined once wedged and had to ``os._exit`` the whole bench."""
+    from t2omca_tpu.utils import watchdog as _wd   # jit-free, stdlib-only
+
+    if attempts is None:
+        try:
+            retries = int(os.environ.get("T2OMCA_BACKEND_PROBE_RETRIES",
+                                         "1"))
+        except ValueError:
+            retries = 1
+        attempts = 1 + max(retries, 0)
+    try:
+        backoff_s = float(os.environ.get("T2OMCA_BACKEND_PROBE_BACKOFF",
+                                         "0.5"))
+    except ValueError:
+        backoff_s = 0.5
     cmd = _cmd or [sys.executable, "-c", "import jax; jax.devices()"]
-    # the bound is TOTAL across both attempts (probe_s/2 each): callers
-    # tune their own timeouts against probe_s, and a retry that doubled
-    # the worst case would push the error record past them — recreating
-    # the no-record-on-stdout failure this probe exists to prevent
-    per_attempt = probe_s / 2.0
-    last, phase = "probe never ran", "timeout"
-    for attempt in (1, 2):
+    deadline = time.monotonic() + probe_s
+    state = {"attempt": 0}
+
+    def _attempt():
+        state["attempt"] += 1
+        a = state["attempt"]
+        remaining = deadline - time.monotonic()
+        per_attempt = remaining / max(attempts - a + 1, 1)
         if per_attempt <= 0:
-            last = (f"backend init exceeded {per_attempt:.0f}s probe "
-                    f"bound (attempt {attempt}/2; wedged tunnel?)")
-            phase = "timeout"
-            continue
+            raise _ProbeTimeout(
+                f"backend init exceeded the {probe_s:.0f}s probe "
+                f"bound (attempt {a}/{attempts}; wedged tunnel?)")
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                 stderr=subprocess.PIPE, text=True)
         try:
             _, err = proc.communicate(timeout=per_attempt)
         except subprocess.TimeoutExpired:
-            last = (f"backend init exceeded {per_attempt:.0f}s probe "
-                    f"bound (attempt {attempt}/2; wedged tunnel?)")
-            phase = "timeout"
-            continue
+            raise _ProbeTimeout(
+                f"backend init exceeded {per_attempt:.0f}s probe "
+                f"bound (attempt {a}/{attempts}; wedged tunnel?)"
+            ) from None
         finally:
             # kill AND reap unconditionally: communicate() does not kill
             # on timeout, and a killed-but-unreaped child is a zombie
             if proc.poll() is None:
                 proc.kill()
             proc.wait()
-        if proc.returncode == 0:
-            return None
-        last = (f"backend unavailable (attempt {attempt}/2): "
+        if proc.returncode != 0:
+            raise _ProbeBackendError(
+                f"backend unavailable (attempt {a}/{attempts}): "
                 f"{err.strip()[-400:]}")
-        phase = "backend_init"
-    return {"error": last[:500], "phase": phase}
+
+    try:
+        # every probe failure class retries (the pre-ladder behavior):
+        # a timeout IS the transient wedge, and backend errors carry the
+        # tunnel's text — fail-fast classification would misread a
+        # garbled stderr as deterministic and skip the retry that
+        # distinguishes a blip from a wedge
+        _wd.retry_call(_attempt, attempts=attempts, backoff_s=backoff_s,
+                       retriable=lambda e: True, label="bench.probe",
+                       sleep=_sleep)
+        return None
+    except _ProbeTimeout as e:
+        return {"error": str(e)[:500], "phase": "timeout"}
+    except _ProbeBackendError as e:
+        return {"error": str(e)[:500], "phase": "backend_init"}
+
+
+def fallback_bound(probe_s: float) -> float:
+    """The slice of the total probe budget RESERVED for the fallback
+    probe. The caller runs the primary probe on ``probe_s -
+    fallback_bound(probe_s)`` so primary + fallback together stay
+    within ``probe_s`` — the no-record-past-the-caller's-timeout
+    invariant holds for the whole probe PHASE, not just the primary.
+    Proportional with no floor: a deliberately tiny budget (tests pin
+    probe_s=0 = immediate-timeout) must not inflate into real waiting."""
+    return min(probe_s / 6.0, 120.0)
+
+
+def probe_fallback(bound: float, _cmd=None) -> dict:
+    """``JAX_PLATFORMS=''`` auto-fallback probe (ROADMAP item 1): after
+    the primary probe fails, ask a child with the platform pin CLEARED
+    whether jax can initialize at all — separating "the pinned
+    platform's tunnel is wedged" (fallback succeeds on another backend)
+    from "jax itself is broken here" (fallback hangs too: auto-detection
+    still tries the wedged plugin first, so the bound fires — that
+    verdict is itself diagnostic). Returns the structured ``fallback``
+    block embedded in the failure record: ``{"platforms": "", "ok":
+    bool, "backend"|"error": str}``. With ``T2OMCA_BENCH_FALLBACK=1``
+    the caller continues the bench on the fallback backend (record
+    tagged with ``platform``) instead of exiting — a CPU smoke number
+    from a wedged-TPU window, clearly labeled, beats no record at all.
+    ``bound`` is the budget slice ``fallback_bound`` reserved."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = ""
+    cmd = _cmd or [sys.executable, "-c",
+                   "import jax; print(jax.default_backend())"]
+    if bound <= 0:
+        return {"platforms": "", "ok": False,
+                "error": "no probe budget left for the fallback"}
+    try:
+        out = subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True,
+                             timeout=bound)
+    except subprocess.TimeoutExpired:
+        return {"platforms": "", "ok": False,
+                "error": f"fallback probe exceeded {bound:.0f}s"}
+    if out.returncode != 0:
+        return {"platforms": "", "ok": False,
+                "error": out.stderr.strip()[-200:]}
+    lines = out.stdout.strip().splitlines()
+    return {"platforms": "", "ok": True,
+            "backend": lines[-1] if lines else "unknown"}
 
 
 def _sync(x):
@@ -439,8 +543,7 @@ def bench_dp(cfg, _time, args) -> int:
     else:
         rec = rollout_rec
     rec.update(pipe_keys)
-    rec["spans"] = _REC.summary()
-    print(json.dumps(rec))
+    print(json.dumps(_finalize(rec)))
     return 0
 
 
@@ -500,7 +603,8 @@ def bench_kernels(make_cfg_kernels, _time, args) -> int:
                        else args.config),
             "n_envs": cfg.batch_size_run,
             "episode_steps": cfg.env_args.episode_limit,
-            "spans": _REC.summary(),
+            **_RECORD_EXTRA,
+        "spans": _REC.summary(),
         }), flush=True)
     return rc
 
@@ -715,6 +819,7 @@ def bench_sebulba(cfg, _time, args) -> int:
         "train_batch_episodes": bs,
         "chained_iters": k,
         "backend": jax.default_backend(),
+        **_RECORD_EXTRA,
         "spans": _REC.summary(),
     }))
     return 0
@@ -778,6 +883,7 @@ def bench_superstep(cfg, _time, args) -> int:
         "train_batch_episodes": bs,
         "train_gate_open": gate_open,
         "dispatch_s": round(dt, 4),
+        **_RECORD_EXTRA,
         "spans": _REC.summary(),
     }))
     return 0
@@ -794,8 +900,7 @@ def bench_train(cfg, _time, args) -> int:
         "vs_baseline": None,
     }
     rec.update(nums)
-    rec["spans"] = _REC.summary()
-    print(json.dumps(rec))
+    print(json.dumps(_finalize(rec)))
     return 0
 
 
@@ -999,8 +1104,7 @@ def bench_prod_hbm(cfg) -> int:
         # analytic-only leg, stated as such:
         "rollout_batch_8192_analytic_gib": round(batch_analytic / gib, 3),
     }
-    rec["spans"] = _REC.summary()
-    print(json.dumps(rec))
+    print(json.dumps(_finalize(rec)))
     return 0
 
 
@@ -1089,6 +1193,7 @@ def bench_serve(args) -> int:
         "backend": jax.default_backend(),
         "artifact": args.artifact,
         "checkpoint_t_env": fe.meta.get("checkpoint", {}).get("t_env"),
+        **_RECORD_EXTRA,
         "spans": _REC.summary(),
     }))
     return 0
@@ -1108,8 +1213,7 @@ def bench_all(make_cfg, _time, _pipe_rate, args) -> int:
     def emit(rec):
         # cumulative per-phase summary (leg meta distinguishes the
         # sub-benches in the span stream; the summary aggregates)
-        rec.setdefault("spans", _REC.summary())
-        print(json.dumps(rec), flush=True)
+        print(json.dumps(_finalize(rec)), flush=True)
 
     def rollout_rate(cfg, label, extra=None):
         # each leg carries its own spans (leg=<label> meta); the
@@ -1423,19 +1527,46 @@ def main() -> int:
                         else ("env_steps_per_sec", "env-steps/s/chip"))
         probe_s = float(os.environ.get("T2OMCA_BACKEND_PROBE_TIMEOUT",
                                        "900"))
+        # the fallback's budget slice is RESERVED up front: primary +
+        # fallback together stay within probe_s, so the failure record
+        # still lands before a caller timeout tuned against probe_s
+        fb_s = fallback_bound(probe_s)
         with _REC.span("bench.probe"):
-            failure = probe_backend(probe_s)
+            failure = probe_backend(probe_s - fb_s)
         if failure is not None:
-            print(json.dumps({
-                "metric": metric, "value": None,
-                "unit": unit, "vs_baseline": None, **failure,
-                "spans": _REC.summary(),
-                # the flight tail rides along like main_flight's partial
-                # record: a wedged-tunnel probe failure then shows its
-                # phase history (BENCH_r03–r05 left only a bare error)
-                "spans_tail": _REC.tail()[-20:],
-            }, default=repr), flush=True)
-            return 1
+            # JAX_PLATFORMS='' auto-fallback probe: the failure record
+            # then says whether ONLY the pinned platform is wedged
+            with _REC.span("bench.probe.fallback"):
+                failure["fallback"] = probe_fallback(fb_s)
+            use_fallback = (failure["fallback"].get("ok")
+                            and os.environ.get("T2OMCA_BENCH_FALLBACK")
+                            == "1")
+            if not use_fallback:
+                print(json.dumps({
+                    "metric": metric, "value": None,
+                    "unit": unit, "vs_baseline": None, **failure,
+                    "spans": _REC.summary(),
+                    # the flight tail rides along like main_flight's
+                    # partial record: a wedged-tunnel probe failure then
+                    # shows its phase history (BENCH_r03–r05 left only a
+                    # bare error)
+                    "spans_tail": _REC.tail()[-20:],
+                }, default=repr), flush=True)
+                return 1
+            # explicit opt-in (T2OMCA_BENCH_FALLBACK=1): continue on the
+            # auto-selected backend — jax is already imported but no
+            # backend is initialized yet (the probe ran in children), so
+            # clearing the pin here still governs platform selection.
+            # The record is tagged `platform` so a fallback number can
+            # never masquerade as the pinned platform's.
+            print(f"# probe failed on the pinned platform "
+                  f"({failure['error'][:120]}); continuing on fallback "
+                  f"backend {failure['fallback']['backend']} "
+                  f"(T2OMCA_BENCH_FALLBACK=1)", file=sys.stderr,
+                  flush=True)
+            jax.config.update("jax_platforms", None)
+            _RECORD_EXTRA["platform"] = failure["fallback"]["backend"]
+            _RECORD_EXTRA["probe_failure"] = failure["error"][:200]
 
     if args.serve:
         # the serving leg needs no train config at all — everything
@@ -1696,7 +1827,7 @@ def main() -> int:
     # + the train half's legs): first_ms isolates the compile,
     # steady_ms the warm rate — the record says where the time went.
     # Set LAST so the train-half spans above are included.
-    line["spans"] = _REC.summary()
+    _finalize(line)
     print(json.dumps(line))
     return 0
 

@@ -131,6 +131,12 @@ TRACE_SYMBOLS = {
     "attn_xla": ("jit__attn_xla", "PjitFunction(_attn_xla)"),
     "attn_pallas": ("jit__attn_pallas", "PjitFunction(_attn_pallas)",
                     "flash_attention_kernel"),
+    # graftworld parameterized env programs (envs/graftworld.py). Like
+    # the attention kernels these jit symbols appear only in standalone
+    # dispatches (the audit, micro-benches) — inside a rollout the env
+    # fuses into the scan body with no distinct symbol.
+    "env_reset": ("jit__env_reset", "PjitFunction(_env_reset)"),
+    "env_step": ("jit__env_step", "PjitFunction(_env_step)"),
 }
 
 
@@ -180,6 +186,7 @@ def collect_default_programs() -> Registry:
     learner and serving surfaces). Each module names its own programs —
     the registry stays free of program-construction knowledge."""
     from .. import run as run_mod
+    from ..envs import graftworld as graftworld_mod
     from ..kernels import attention as kernels_mod
     from ..learners import qmix_learner as learner_mod
     from ..parallel import mesh as mesh_mod
@@ -189,7 +196,7 @@ def collect_default_programs() -> Registry:
     reg: Registry = {}
     ctx = audit_context()
     for mod in (run_mod, mesh_mod, sebulba_mod, learner_mod, serve_mod,
-                kernels_mod):
+                kernels_mod, graftworld_mod):
         hook = getattr(mod, "register_audit_programs", None)
         if hook is None:
             continue

@@ -21,6 +21,43 @@ from typing import Any, Optional, Tuple
 
 
 @dataclass(frozen=True)
+class ScenarioConfig:
+    """graftworld scenario-distribution surface (``env_args.scenario.*``,
+    envs/graftworld.py, docs/ENVS.md). Every collection field is a tuple
+    — the config tree stays hashable, so jitted programs can close over
+    the resolved distribution as static structure. ``kind`` empty (the
+    default) means "this env key's registry default scenario"
+    (envs/registry.py ``scenario_config``), which for the classic
+    ``multi_agv_offloading`` key is the fixed baseline — byte-identical
+    behavior for every pre-graftworld config. An EXPLICIT kind always
+    wins over the registry default, even when it names the baseline
+    point (the empty sentinel exists exactly so that explicit-baseline-
+    over-a-family-key stays expressible)."""
+
+    # "" = the env key's registry default; fixed = one parameter point;
+    # uniform = uniform ranges over knobs; mixture = weighted mixture
+    # over family distributions
+    kind: str = ""
+    # the scenario family (fixed/uniform kinds): baseline | hetfleet |
+    # interference | surge (envs/graftworld.FAMILY_NAMES)
+    family: str = "baseline"
+    # uniform kind: ((knob, lo, hi), ...); empty = the family's
+    # canonical envelope (graftworld.FAMILY_RANGES)
+    ranges: Tuple[Tuple[str, float, float], ...] = ()
+    # fixed/uniform kinds: ((knob, value), ...) applied over the
+    # family preset before any range draws
+    overrides: Tuple[Tuple[str, float], ...] = ()
+    # mixture kind: component family names; empty = all families
+    families: Tuple[str, ...] = ()
+    # mixture kind: component weights; empty = uniform
+    weights: Tuple[float, ...] = ()
+    # fleet-size randomization (the padding axis): each lane draws
+    # n_active ~ U{min_agents..agv_num} at reset; 0 = always the full
+    # static fleet
+    min_agents: int = 0
+
+
+@dataclass(frozen=True)
 class EnvConfig:
     """Environment flags (reference ``env_args``, SURVEY.md §5.6)."""
 
@@ -66,6 +103,11 @@ class EnvConfig:
     job_prob: float = 0.5                 # P(generate_job emits a job) per slot (M1)
     data_size_min: float = 4000.0         # bits (M1)
     data_size_max: float = 12000.0        # bits (M1)
+
+    # graftworld scenario distribution (envs/graftworld.py, docs/ENVS.md):
+    # which EnvParams each env lane samples at reset. Default = the env
+    # key's registry default (fixed baseline for the classic key).
+    scenario: "ScenarioConfig" = field(default_factory=lambda: ScenarioConfig())
 
 
 @dataclass(frozen=True)
@@ -608,6 +650,66 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
         raise ValueError(
             f"model.act_dtype must be ''/float32/bfloat16 ('' inherits "
             f"model.dtype), got {cfg.model.act_dtype!r}")
+    # graftworld scenario surface (env_args.scenario.*). Name sets are
+    # mirrored from envs/graftworld.py (config cannot import it —
+    # circular) and pinned by tests/test_graftworld.py, the same pattern
+    # as the agent/mixer registries above.
+    _scn_kinds = {"", "fixed", "uniform", "mixture"}
+    _scn_families = {"baseline", "hetfleet", "interference", "surge"}
+    _scn_fields = {"n_active", "gain_scale", "interference_w", "mec_scale",
+                   "teleport_prob", "job_prob", "surge_amp", "surge_period",
+                   "deadline_ms", "mec_compute_scale", "compute_scale",
+                   "tx_scale"}
+    scn = cfg.env_args.scenario
+    if scn.kind not in _scn_kinds:
+        raise ValueError(f"env_args.scenario.kind must be one of "
+                         f"{sorted(_scn_kinds)}, got {scn.kind!r}")
+    if scn.family not in _scn_families:
+        raise ValueError(f"env_args.scenario.family must be one of "
+                         f"{sorted(_scn_families)}, got {scn.family!r}")
+    for f in scn.families:
+        if f not in _scn_families:
+            raise ValueError(f"env_args.scenario.families entry {f!r} "
+                             f"unknown; valid: {sorted(_scn_families)}")
+    if scn.weights and len(scn.weights) != len(scn.families or
+                                               _scn_families):
+        raise ValueError(
+            f"env_args.scenario.weights ({len(scn.weights)}) must match "
+            f"the mixture component count "
+            f"({len(scn.families or _scn_families)})")
+    if any(w < 0 for w in scn.weights) or (scn.weights
+                                           and sum(scn.weights) <= 0):
+        raise ValueError("env_args.scenario.weights must be non-negative "
+                         "with a positive sum")
+    for name, *bounds in tuple(scn.ranges) + tuple(scn.overrides):
+        if name not in _scn_fields:
+            raise ValueError(
+                f"env_args.scenario knob {name!r} is not a randomizable "
+                f"EnvParams field; valid: {sorted(_scn_fields)}")
+        if name == "deadline_ms":
+            hi = max(float(b) for b in bounds)
+            lo = min(float(b) for b in bounds)
+            if hi > cfg.env_args.latency_max_ms or lo <= 0:
+                raise ValueError(
+                    f"env_args.scenario deadline_ms values must lie in "
+                    f"(0, latency_max_ms={cfg.env_args.latency_max_ms}] "
+                    f"— latency_max fixes the static job-queue shape "
+                    f"(got {bounds})")
+        if name == "n_active":
+            if (min(float(b) for b in bounds) < 1
+                    or max(float(b) for b in bounds)
+                    > cfg.env_args.agv_num):
+                raise ValueError(
+                    f"env_args.scenario n_active values must lie in "
+                    f"[1, agv_num={cfg.env_args.agv_num}], got {bounds}")
+    for name, lo, hi in scn.ranges:
+        if not float(lo) <= float(hi):
+            raise ValueError(f"env_args.scenario.ranges[{name!r}]: "
+                             f"lo={lo} > hi={hi}")
+    if not 0 <= scn.min_agents <= cfg.env_args.agv_num:
+        raise ValueError(
+            f"env_args.scenario.min_agents must be in "
+            f"[0, agv_num={cfg.env_args.agv_num}], got {scn.min_agents}")
     if cfg.mixer == "transformer" and cfg.model.mixer_emb != cfg.model.emb:
         raise ValueError(
             "mixer_emb must equal emb: the transformer mixer concatenates "
@@ -629,6 +731,23 @@ def check_dp_divisibility(cfg: TrainConfig, n: int,
             f"batch_size={cfg.batch_size} and "
             f"replay.buffer_size={cfg.replay.buffer_size} must all be "
             f"divisible by {axis_label}={n}")
+
+
+def _coerce_scenario(base: ScenarioConfig, kw: dict) -> ScenarioConfig:
+    """Normalize a scenario dict (YAML lists, JSON round trips) onto the
+    tuple-typed frozen ScenarioConfig."""
+    kw = dict(kw)
+    if "ranges" in kw:
+        kw["ranges"] = tuple(
+            (str(n), float(lo), float(hi)) for n, lo, hi in kw["ranges"])
+    if "overrides" in kw:
+        kw["overrides"] = tuple(
+            (str(n), float(v)) for n, v in kw["overrides"])
+    if "families" in kw:
+        kw["families"] = tuple(str(f) for f in kw["families"])
+    if "weights" in kw:
+        kw["weights"] = tuple(float(w) for w in kw["weights"])
+    return dataclasses.replace(base, **kw)
 
 
 def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
@@ -679,6 +798,18 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
             raise KeyError(f"unknown config key: {k}")
 
     if env_kw:
+        # scenario sub-tree: a nested dict (YAML), dotted keys (CLI
+        # `env_args.scenario.kind=...` arrives here as "scenario.kind"),
+        # or an already-built ScenarioConfig (from_dict re-entry)
+        scn_kw = env_kw.pop("scenario", None)
+        scn_kw = ({} if scn_kw is None
+                  else dataclasses.asdict(scn_kw)
+                  if isinstance(scn_kw, ScenarioConfig) else dict(scn_kw))
+        for k in [k for k in env_kw if k.startswith("scenario.")]:
+            scn_kw[k.split(".", 1)[1]] = env_kw.pop(k)
+        if scn_kw:
+            env_kw["scenario"] = _coerce_scenario(cfg.env_args.scenario,
+                                                  scn_kw)
         updates["env_args"] = dataclasses.replace(cfg.env_args, **env_kw)
     if model_kw:
         updates["model"] = dataclasses.replace(cfg.model, **model_kw)

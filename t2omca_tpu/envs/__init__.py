@@ -1,11 +1,17 @@
 from .critic import critic
-from .mec_offload import EnvState, MultiAgvOffloadingEnv, StepInfo
+from .graftworld import (FAMILY_IDS, FAMILY_NAMES, FixedScenario,
+                         MixtureScenario, ScenarioDistribution,
+                         UniformScenario, family_distribution,
+                         make_distribution)
+from .mec_offload import EnvParams, EnvState, MultiAgvOffloadingEnv, StepInfo
 from .normalization import (NormState, RewardScaleState, normalize,
                             reset_reward_scale, scale_reward, welford_update)
-from .registry import REGISTRY, make_env
+from .registry import (ALIASES, REGISTRY, EnvEntry, make_env,
+                       make_scenario_distribution, resolve, scenario_config)
 
 __all__ = [
     "critic",
+    "EnvParams",
     "EnvState",
     "MultiAgvOffloadingEnv",
     "StepInfo",
@@ -16,5 +22,18 @@ __all__ = [
     "scale_reward",
     "reset_reward_scale",
     "REGISTRY",
+    "ALIASES",
+    "EnvEntry",
     "make_env",
+    "resolve",
+    "scenario_config",
+    "make_scenario_distribution",
+    "FAMILY_NAMES",
+    "FAMILY_IDS",
+    "ScenarioDistribution",
+    "FixedScenario",
+    "UniformScenario",
+    "MixtureScenario",
+    "family_distribution",
+    "make_distribution",
 ]

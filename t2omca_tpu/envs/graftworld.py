@@ -1,0 +1,298 @@
+"""graftworld: vmapped, domain-randomized scenario distributions.
+
+ROADMAP item 3 (JaxMARL / NAVIX, PAPERS.md): the registry used to hold
+ONE MEC-offload scenario with fixed parameters, so the "as many
+scenarios as you can imagine" north star was untested beyond a single
+configuration. graftworld lifts the frozen knobs into the
+:class:`~t2omca_tpu.envs.mec_offload.EnvParams` pytree (which vmaps
+alongside ``EnvState``) and supplies the sampling layer above it:
+
+* **scenario families** — named parameter regimes implemented as
+  EnvParams-driven variants sharing the ONE core ``step``: ``baseline``
+  (the reference scenario), ``hetfleet`` (heterogeneous per-AGV
+  compute/transmit capability), ``interference`` (adversarial channel
+  interference + degraded fading), ``surge`` (non-stationary sinusoidal
+  traffic surges). No family introduces control flow — every variant is
+  purely parametric, so a mixture over families runs in one compiled
+  program with zero per-family recompiles.
+* **distributions** — :class:`FixedScenario` (one fixed parameter
+  point), :class:`UniformScenario` (uniform ranges over named knobs),
+  :class:`MixtureScenario` (weighted mixture over family
+  distributions). All are frozen/hashable dataclasses, so jitted
+  programs close over them as static structure; ``sample(key, env)``
+  is traced — each env lane draws its own scenario at reset inside the
+  rollout program (zero extra dispatches).
+* **per-slice eval** — every sample carries its family id in
+  ``EnvParams.family``; the runner threads it into ``RolloutStats.
+  scenario`` and the stats accumulators report return / deadline-miss /
+  collision rates PER family slice (utils/stats.py, ``obs report``),
+  measuring generalization instead of a mixture-blurred mean.
+
+Config surface: ``env_args.scenario.*`` (config.ScenarioConfig; YAML
+exemplar configs/config6_scenarios.yaml); registry wiring:
+``envs/registry.py`` (each env key carries a default scenario).
+Contract: docs/ENVS.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .mec_offload import EnvParams, MultiAgvOffloadingEnv
+
+#: family order IS the ``EnvParams.family`` id assignment — stable,
+#: append-only (the per-slice metric keys and the report CLI's name
+#: column depend on it; ``obs/report.py`` mirrors this tuple to stay
+#: jax-free, pinned by tests/test_graftworld.py)
+FAMILY_NAMES: Tuple[str, ...] = ("baseline", "hetfleet", "interference",
+                                 "surge")
+FAMILY_IDS: Dict[str, int] = {n: i for i, n in enumerate(FAMILY_NAMES)}
+
+#: per-family canonical FIXED parameter points (``kind: fixed`` with a
+#: non-baseline family): deterministic, key-free presets — hetfleet uses
+#: a linspace capability gradient instead of random draws so a fixed
+#: scenario is actually fixed
+FAMILY_FIXED: Dict[str, Tuple[Tuple[str, object], ...]] = {
+    "baseline": (),
+    "hetfleet": (("compute_scale", ("linspace", 0.5, 2.0)),
+                 ("tx_scale", ("linspace", 2.0, 0.5))),
+    "interference": (("interference_w", 4e-11), ("gain_scale", 0.7)),
+    "surge": (("surge_amp", 0.8), ("surge_period", 40.0)),
+}
+
+#: per-family default UNIFORM ranges (``kind: uniform`` with no explicit
+#: ``ranges``): the domain-randomization envelope each family trains
+#: over. Bounds live in the same units as the EnvParams leaf.
+FAMILY_RANGES: Dict[str, Tuple[Tuple[str, float, float], ...]] = {
+    "baseline": (),
+    "hetfleet": (("compute_scale", 0.5, 2.0), ("tx_scale", 0.5, 2.0)),
+    "interference": (("interference_w", 1e-11, 8e-11),
+                     ("gain_scale", 0.4, 1.0)),
+    "surge": (("surge_amp", 0.4, 1.0), ("surge_period", 20.0, 80.0),
+              ("job_prob", 0.3, 0.7)),
+}
+
+#: EnvParams leaves a distribution may randomize / override (``family``
+#: is assigned by the distribution, never listed). ``config.
+#: sanity_check`` mirrors this tuple (it cannot import this module —
+#: circular); tests/test_graftworld.py pins the mirror.
+RANDOMIZABLE_FIELDS: Tuple[str, ...] = (
+    "n_active", "gain_scale", "interference_w", "mec_scale",
+    "teleport_prob", "job_prob", "surge_amp", "surge_period",
+    "deadline_ms", "mec_compute_scale", "compute_scale", "tx_scale",
+)
+
+
+def _base_params(env: MultiAgvOffloadingEnv, family: str,
+                 overrides: Tuple[Tuple[str, object], ...]) -> EnvParams:
+    """Family-tagged default params + the family's fixed preset + caller
+    overrides (override values may be scalars or, for (A,)-shaped
+    leaves, the ``("linspace", lo, hi)`` gradient form)."""
+    p = env.default_params()
+    updates = {"family": jnp.asarray(FAMILY_IDS[family], jnp.int32)}
+    for name, value in tuple(FAMILY_FIXED[family]) + tuple(overrides):
+        leaf = getattr(p, name)
+        if isinstance(value, tuple) and value and value[0] == "linspace":
+            updates[name] = jnp.linspace(float(value[1]), float(value[2]),
+                                         leaf.shape[0], dtype=leaf.dtype)
+        else:
+            updates[name] = jnp.broadcast_to(
+                jnp.asarray(value, leaf.dtype), leaf.shape)
+    return p.replace(**updates)
+
+
+def _sample_n_active(key: jax.Array, env: MultiAgvOffloadingEnv,
+                     min_agents: int) -> jnp.ndarray:
+    """Uniform fleet size in [min_agents, agv_num] (the padding axis)."""
+    return jax.random.randint(key, (), min_agents, env.n_agents + 1,
+                              dtype=jnp.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioDistribution:
+    """Base: a hashable (jit-static) sampler of EnvParams instances."""
+
+    def sample(self, key: jax.Array, env: MultiAgvOffloadingEnv
+               ) -> EnvParams:
+        raise NotImplementedError
+
+    def families(self) -> Tuple[str, ...]:
+        """Family names this distribution can emit (per-slice eval)."""
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedScenario(ScenarioDistribution):
+    """One fixed parameter point: the family's canonical preset plus
+    ``overrides``. ``min_agents > 0`` still randomizes the fleet size
+    (it is the padding axis, orthogonal to the family knobs)."""
+
+    family: str = "baseline"
+    overrides: Tuple[Tuple[str, float], ...] = ()
+    min_agents: int = 0
+
+    def sample(self, key: jax.Array, env: MultiAgvOffloadingEnv
+               ) -> EnvParams:
+        p = _base_params(env, self.family, self.overrides)
+        if self.min_agents:
+            p = p.replace(n_active=_sample_n_active(
+                jax.random.fold_in(key, 0), env, self.min_agents))
+        return p
+
+    def families(self) -> Tuple[str, ...]:
+        return (self.family,)
+
+
+@dataclasses.dataclass(frozen=True)
+class UniformScenario(ScenarioDistribution):
+    """Uniform draws over named knob ranges, on top of the family's
+    defaults + ``overrides``. Empty ``ranges`` means the family's
+    canonical envelope (:data:`FAMILY_RANGES`). (A,)-shaped knobs draw
+    i.i.d. per agent; ``n_active`` draws an integer fleet size."""
+
+    family: str = "baseline"
+    ranges: Tuple[Tuple[str, float, float], ...] = ()
+    overrides: Tuple[Tuple[str, float], ...] = ()
+    min_agents: int = 0
+
+    def effective_ranges(self) -> Tuple[Tuple[str, float, float], ...]:
+        return self.ranges or FAMILY_RANGES[self.family]
+
+    def sample(self, key: jax.Array, env: MultiAgvOffloadingEnv
+               ) -> EnvParams:
+        p = _base_params(env, self.family, self.overrides)
+        updates = {}
+        # fold_in per field index: adding a range never reshuffles the
+        # draws of the ranges before it
+        for i, (name, lo, hi) in enumerate(self.effective_ranges()):
+            k = jax.random.fold_in(key, i + 1)
+            leaf = getattr(p, name)
+            if name == "n_active":
+                updates[name] = jax.random.randint(
+                    k, (), int(lo), int(hi) + 1, dtype=jnp.int32)
+            else:
+                updates[name] = jax.random.uniform(
+                    k, leaf.shape, leaf.dtype, minval=float(lo),
+                    maxval=float(hi))
+        if self.min_agents and "n_active" not in updates:
+            updates["n_active"] = _sample_n_active(
+                jax.random.fold_in(key, 0), env, self.min_agents)
+        return p.replace(**updates)
+
+    def families(self) -> Tuple[str, ...]:
+        return (self.family,)
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtureScenario(ScenarioDistribution):
+    """Weighted mixture over component distributions: draw a component
+    index, sample every component, select the drawn one leaf-wise — a
+    ``jnp.stack`` + gather, so the mixture is ONE traced program (no
+    per-family branch, no recompile; acceptance criterion of ISSUE 11)."""
+
+    components: Tuple[ScenarioDistribution, ...] = ()
+    weights: Tuple[float, ...] = ()
+
+    def sample(self, key: jax.Array, env: MultiAgvOffloadingEnv
+               ) -> EnvParams:
+        if not self.components:
+            raise ValueError("MixtureScenario needs >= 1 component")
+        n = len(self.components)
+        w = (jnp.asarray(self.weights, jnp.float32) if self.weights
+             else jnp.full((n,), 1.0 / n, jnp.float32))
+        k_pick, k_sample = jax.random.split(key)
+        idx = jax.random.choice(k_pick, n, p=w / w.sum())
+        cand = [c.sample(jax.random.fold_in(k_sample, i), env)
+                for i, c in enumerate(self.components)]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *cand)
+        return jax.tree.map(lambda s: s[idx], stacked)
+
+    def families(self) -> Tuple[str, ...]:
+        seen = []
+        for c in self.components:
+            for f in c.families():
+                if f not in seen:
+                    seen.append(f)
+        return tuple(seen)
+
+
+def family_distribution(name: str, min_agents: int = 0
+                        ) -> ScenarioDistribution:
+    """The canonical per-family distribution: baseline is its fixed
+    point, every other family is its uniform envelope — the defaults
+    the registry's family env keys train over."""
+    if name not in FAMILY_IDS:
+        raise KeyError(f"unknown scenario family {name!r}; known: "
+                       f"{list(FAMILY_NAMES)}")
+    if name == "baseline":
+        return FixedScenario(min_agents=min_agents)
+    return UniformScenario(family=name, min_agents=min_agents)
+
+
+def make_distribution(scn) -> ScenarioDistribution:
+    """``config.ScenarioConfig`` → distribution (the YAML/CLI surface:
+    ``env_args.scenario.kind`` fixed | uniform | mixture). Validation
+    beyond ``config.sanity_check``'s jax-free mirror happens here."""
+    kind = scn.kind or "fixed"    # "" = registry-default sentinel; a
+    # bare config resolves through registry.scenario_config first, so
+    # reaching here with "" means "the fixed point of scn.family"
+    if kind == "fixed":
+        return FixedScenario(family=scn.family, overrides=scn.overrides,
+                             min_agents=scn.min_agents)
+    if kind == "uniform":
+        return UniformScenario(family=scn.family, ranges=scn.ranges,
+                               overrides=scn.overrides,
+                               min_agents=scn.min_agents)
+    if kind == "mixture":
+        fams = scn.families or FAMILY_NAMES
+        return MixtureScenario(
+            components=tuple(family_distribution(f, scn.min_agents)
+                             for f in fams),
+            weights=tuple(scn.weights))
+    raise ValueError(f"unknown scenario kind {kind!r}; "
+                     f"valid: fixed/uniform/mixture")
+
+
+def register_audit_programs(ctx):
+    """graftprog registry hook: the vmapped PARAMETERIZED env programs,
+    lowered over a mixture spanning every family — the scenario-path
+    cost surface. Ratcheting ``env_reset``/``env_step`` in
+    analysis/programs.json means a scenario-induced FLOPs/bytes
+    regression (a family knob acquiring an accidental O(A²) term, say)
+    fails the graftprog gate statically (ISSUE 11 satellite)."""
+    from ..analysis.registry import AuditProgram
+    env = ctx.exp.env
+    cfg = ctx.cfg
+    b = cfg.batch_size_run
+    dist = MixtureScenario(components=tuple(
+        family_distribution(f) for f in FAMILY_NAMES))
+
+    def _sample(keys):
+        return jax.vmap(lambda k: dist.sample(k, env))(keys)
+
+    def _env_reset(keys, norms, params):
+        return jax.vmap(env.reset)(keys, norms, params)
+
+    def _env_step(states, actions, keys, params):
+        return jax.vmap(env.step)(states, actions, keys, params)
+
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    keys = jax.ShapeDtypeStruct((b,) + key.shape, key.dtype)
+    params = jax.eval_shape(_sample, keys)
+    norms = ctx.ts_shape.runner.env_states.norm
+    states = ctx.ts_shape.runner.env_states
+    actions = jax.ShapeDtypeStruct((b, env.n_agents), jnp.int32)
+    return {
+        "env_reset": AuditProgram(
+            jax.jit(_env_reset), (keys, norms, params),
+            description="vmapped parameterized env reset (graftworld "
+                        "EnvParams, all-family mixture avals)"),
+        "env_step": AuditProgram(
+            jax.jit(_env_step), (states, actions, keys, params),
+            description="vmapped parameterized env step (graftworld "
+                        "EnvParams, all-family mixture avals)"),
+    }

@@ -61,6 +61,58 @@ def _round(x: jnp.ndarray, decimals: int = 0) -> jnp.ndarray:
 
 
 @struct.dataclass
+class EnvParams:
+    """Per-instance scenario knobs (graftworld, docs/ENVS.md): every leaf
+    is a jnp array, so the pytree vmaps alongside :class:`EnvState` — one
+    compiled ``reset``/``step`` serves every scenario in a sampled
+    distribution with zero extra dispatches and no per-family recompile
+    (the JaxMARL/NAVIX parameterized-env pattern, PAPERS.md).
+
+    The **default values are exactly the fixed scenario the physics
+    constants below encode**: every knob enters the math as a
+    multiply-by-1 / add-0 / all-true-mask neutral element, so
+    ``env.default_params()`` reproduces the pre-graftworld env
+    BIT-identically (pinned by tests/test_graftworld.py goldens). Knob
+    groups:
+
+    * fleet size — ``n_active`` of the static ``agv_num`` maximum; the
+      rest are padded agents (no jobs, action 0 only, zero reward, a
+      unique negative ``mec_index`` sentinel so they are invisible in
+      every same-MEC visibility/collision structure);
+    * channel fading / interference — linear SNR multiplier +
+      additive interference power on the noise floor;
+    * MEC placement & AGV mobility — placement stretch and per-step
+      teleport probability (1.0 = the reference's always-teleport Q6);
+    * job-arrival regime — base Bernoulli rate plus a sinusoidal
+      surge modulation (non-stationary traffic);
+    * deadline distribution — per-instance deadline budget (bounded by
+      the static ``latency_max_ms``, which fixes the queue shape);
+    * heterogeneous fleets — per-AGV compute/transmit capability
+      scales (the first (A,)-shaped knobs);
+    * ``family`` — the scenario-family tag carried through rollout
+      stats for per-slice generalization eval (utils/stats.py).
+    """
+
+    n_active: jnp.ndarray           # () int32 — active AGVs (rest padded)
+    gain_scale: jnp.ndarray         # () f32 — linear channel-gain multiplier
+    interference_w: jnp.ndarray     # () f32 — adversarial interference [W]
+    mec_scale: jnp.ndarray          # () f32 — MEC placement stretch
+    teleport_prob: jnp.ndarray      # () f32 — per-step AGV teleport prob
+    job_prob: jnp.ndarray           # () f32 — base job-arrival rate
+    surge_amp: jnp.ndarray          # () f32 — traffic-surge amplitude
+    surge_period: jnp.ndarray       # () f32 — surge period [slots]
+    deadline_ms: jnp.ndarray        # () f32 — job deadline budget
+    mec_compute_scale: jnp.ndarray  # () f32 — MEC compute-cap multiplier
+    compute_scale: jnp.ndarray      # (A,) f32 — per-AGV compute capability
+    tx_scale: jnp.ndarray           # (A,) f32 — per-AGV transmit power
+    family: jnp.ndarray             # () int32 — scenario family/bucket id
+
+    def agent_mask(self, n_agents: int) -> jnp.ndarray:
+        """(A,) bool — True for active agents, False for padded ones."""
+        return jnp.arange(n_agents) < self.n_active
+
+
+@struct.dataclass
 class EnvState:
     """Per-env dynamic state (one vmap lane = one reference subprocess env)."""
 
@@ -90,6 +142,10 @@ class StepInfo:
     episode_limit: jnp.ndarray          # bool: terminated due to time limit
     task_completion_rate: jnp.ndarray   # valid when episode_limit
     task_completion_delay: jnp.ndarray  # valid when episode_limit
+    # deadline-miss rate: generated jobs neither completed in deadline nor
+    # still queued, / generated (graftworld per-slice eval metric — counts
+    # late local/offload completions AND queue-expired drops exactly once)
+    deadline_miss_rate: jnp.ndarray
 
 
 @dataclasses.dataclass(frozen=True)
@@ -153,17 +209,48 @@ class MultiAgvOffloadingEnv:
     def state_dim(self) -> int:
         return self.n_agents * self.state_entity_feats
 
-    def mec_positions(self) -> jnp.ndarray:
-        """MECs on a line at spacing 2*radius (reference :23-28)."""
+    def default_params(self) -> EnvParams:
+        """The fixed reference scenario as an :class:`EnvParams` instance:
+        every knob is the neutral element of the expression it enters, so
+        running with these is bit-identical to the pre-graftworld env
+        (pinned golden digests in tests/test_graftworld.py)."""
+        a = self.n_agents
+        return EnvParams(
+            n_active=jnp.asarray(a, jnp.int32),
+            gain_scale=jnp.asarray(1.0, jnp.float32),
+            interference_w=jnp.asarray(0.0, jnp.float32),
+            mec_scale=jnp.asarray(1.0, jnp.float32),
+            teleport_prob=jnp.asarray(1.0, jnp.float32),
+            job_prob=jnp.asarray(self.cfg.job_prob, jnp.float32),
+            surge_amp=jnp.asarray(0.0, jnp.float32),
+            surge_period=jnp.asarray(40.0, jnp.float32),
+            deadline_ms=jnp.asarray(self.cfg.latency_max_ms, jnp.float32),
+            mec_compute_scale=jnp.asarray(1.0, jnp.float32),
+            compute_scale=jnp.ones((a,), jnp.float32),
+            tx_scale=jnp.ones((a,), jnp.float32),
+            family=jnp.asarray(0, jnp.int32),
+        )
+
+    def _p(self, params: "EnvParams | None") -> EnvParams:
+        """Resolve the optional ``params`` argument: None = the fixed
+        default scenario (keeps every pre-graftworld call site valid)."""
+        return self.default_params() if params is None else params
+
+    def mec_positions(self, params: "EnvParams | None" = None) -> jnp.ndarray:
+        """MECs on a line at spacing 2*radius (reference :23-28), stretched
+        by ``params.mec_scale`` (1.0 = reference placement, bit-exact)."""
         r = self.cfg.mec_radius_m
         xs = np.arange(self.n_mec) * (2 * r) + r
         ys = np.full(self.n_mec, r)
-        return jnp.asarray(np.stack([xs, ys], axis=1), jnp.float32)
+        base = jnp.asarray(np.stack([xs, ys], axis=1), jnp.float32)
+        if params is None:
+            return base
+        return base * params.mec_scale
 
     # ------------------------------------------------------------------ helpers
 
-    def _random_positions(self, key: jax.Array,
-                          mec_index: jnp.ndarray) -> jnp.ndarray:
+    def _random_positions(self, key: jax.Array, mec_index: jnp.ndarray,
+                          params: EnvParams) -> jnp.ndarray:
         """M13: uniform point inside the serving MEC's communication circle."""
         k1, k2 = jax.random.split(key)
         a = self.n_agents
@@ -171,40 +258,63 @@ class MultiAgvOffloadingEnv:
         theta = jax.random.uniform(k2, (a,), maxval=2 * np.pi)
         rad = self.cfg.communication_range_m * jnp.sqrt(u)
         offset = jnp.stack([rad * jnp.cos(theta), rad * jnp.sin(theta)], axis=1)
-        return self.mec_positions()[mec_index] + offset
+        return self.mec_positions(params)[mec_index] + offset
 
-    def _local_delay(self, data: jnp.ndarray, decimals: int) -> jnp.ndarray:
-        """Local compute delay in ms (reference :127, :247-248)."""
+    def _local_delay(self, data: jnp.ndarray, decimals: int,
+                     params: EnvParams) -> jnp.ndarray:
+        """Local compute delay in ms (reference :127, :247-248); the cap is
+        scaled per-AGV by ``params.compute_scale`` (heterogeneous fleets).
+        The knob divides the reference expression as a TRAILING step:
+        XLA rewrites the reference's divide-by-constant caps into
+        reciprocal multiplies, so folding the scale into the divisor
+        would change the lowering (and the bits) even at scale=1 —
+        appending ``/ scale`` keeps the default path's ops identical
+        (/1.0 is exact) and the parity goldens green."""
         return _round(self.computation_cycles * data
-                      / self.cfg.user_compute_cap * 1000.0, decimals)
+                      / self.cfg.user_compute_cap * 1000.0
+                      / params.compute_scale, decimals)
 
     def _offload_delay(self, data: jnp.ndarray, pos: jnp.ndarray,
-                       mec_index: jnp.ndarray) -> jnp.ndarray:
+                       mec_index: jnp.ndarray,
+                       params: EnvParams) -> jnp.ndarray:
         """Shannon-rate transmit delay + MEC compute delay in ms
         (reference ``calculate_offload_delay`` :106-121). Note the quirk kept
         verbatim: path-loss linearization uses base ``self.path_loss`` (=3),
-        i.e. ``3 ** (-dB/10)``, not ``10 ** (-dB/10)`` (:112)."""
+        i.e. ``3 ** (-dB/10)``, not ``10 ** (-dB/10)`` (:112). graftworld
+        knobs enter as TRAILING neutral operations on the reference
+        expressions (multiply by 1 / divide by 1, exact): per-AGV transmit
+        scale and channel-fading gain multiply the reference SNR,
+        interference divides it by ``1 + I/N0`` (algebraically the lifted
+        noise floor ``N0 + I``), MEC compute delay divides by the cap
+        scale — so the default (1/1/0/1) path runs the reference ops
+        bit-identically (see the ``_local_delay`` lowering note)."""
         gain_lin = 10.0 ** (self.channel_gain_db / 10.0)
-        d = jnp.linalg.norm(pos - self.mec_positions()[mec_index], axis=-1)
+        d = jnp.linalg.norm(pos - self.mec_positions(params)[mec_index],
+                            axis=-1)
         pl_db = 128.1 + 37.6 * jnp.log10(d + 0.1)
         pl_lin = self.path_loss_base ** (-pl_db / 10.0)
-        snr = gain_lin * self.cfg.transmit_power_w * pl_lin / self.noise_power
+        snr = (gain_lin * self.cfg.transmit_power_w * pl_lin
+               / self.noise_power
+               * params.gain_scale * params.tx_scale
+               / (1.0 + params.interference_w / self.noise_power))
         rate = self.bandwidth * jnp.log2(1.0 + snr)
         transmit = data / rate * 1000.0
         compute = (self.computation_cycles * data
-                   / self.cfg.mec_compute_cap) * 1000.0
+                   / self.cfg.mec_compute_cap) * 1000.0 \
+            / params.mec_compute_scale
         return _round(transmit + compute, 2)
 
-    def _agent_inf(self, state: EnvState) -> jnp.ndarray:
+    def _agent_inf(self, state: EnvState, params: EnvParams) -> jnp.ndarray:
         """Per-agent feature rows ``[data_size, data_delay, offload_delay,
         remaining_delay, buffer_length]`` (reference ``get_agent_inf``
-        :123-146), zeros for empty buffers."""
+        :123-146), zeros for empty buffers (padded agents never hold a
+        job, so their rows are zero by the same gate)."""
         has_job = state.job_valid[:, 0]
         data = state.job_data[:, 0]
         inf = jnp.stack([
             data,
-            self._local_delay(data, 0),
-            self._offload_delay(data, state.pos, state.mec_index),
+            self._local_delay(data, 0, params),
+            self._offload_delay(data, state.pos, state.mec_index, params),
             state.job_deadline[:, 0],
             state.job_valid.sum(axis=1).astype(jnp.float32),
         ], axis=1)
@@ -218,31 +328,35 @@ class MultiAgvOffloadingEnv:
 
     # ------------------------------------------------------------------ obs/state
 
-    def _entity_parts(self, state: EnvState
+    def _entity_parts(self, state: EnvState, params: EnvParams
                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Factored entity obs pieces: feature ``rows (A, 8)`` and the
-        ``same_mec (A, A)`` visibility mask."""
-        inf = self._agent_inf(state)
+        ``same_mec (A, A)`` visibility mask. Padded agents carry a unique
+        negative ``mec_index`` sentinel (set at reset/teleport), so the
+        equality mask makes them visible only to themselves — the SAME
+        rule the compact-entity storage path reconstructs from the stored
+        ``mec_index`` (ops/query_slice.py), with zero schema change."""
+        inf = self._agent_inf(state, params)
         ack1h = self._ack_onehot(state.last_ack)
         rows = jnp.concatenate([ack1h, inf], axis=1)               # (A, 8)
         same_mec = state.mec_index[:, None] == state.mec_index[None, :]
         return rows, same_mec
 
-    def _raw_obs(self, state: EnvState) -> jnp.ndarray:
+    def _raw_obs(self, state: EnvState, params: EnvParams) -> jnp.ndarray:
         """(A, obs_dim) pre-normalization observations."""
         if self.cfg.obs_entity_mode:
             a = self.n_agents
-            rows, same_mec = self._entity_parts(state)
+            rows, same_mec = self._entity_parts(state, params)
             ent = jnp.where(same_mec[:, :, None],
                             jnp.broadcast_to(rows[None], (a, a, 8)), 0.0)
             is_self = jnp.eye(a)[:, :, None]       # diagonal is always same-MEC
             ent = jnp.concatenate([ent, is_self], axis=2)          # (A, A, 9)
             return ent.reshape(a, a * self.obs_entity_feats)
-        inf = self._agent_inf(state)
+        inf = self._agent_inf(state, params)
         return jnp.concatenate(
             [state.last_ack[:, None].astype(jnp.float32), inf], axis=1)
 
-    def get_obs(self, state: EnvState,
+    def get_obs(self, state: EnvState, params: "EnvParams | None" = None,
                 update_norm: bool = True) -> Tuple[EnvState, jnp.ndarray]:
         """Normalized per-agent observations. Default path: the Welford
         state is updated agent-by-agent in order, each agent normalized with
@@ -252,6 +366,7 @@ class MultiAgvOffloadingEnv:
         A-step sequential scan (the env-step serialization bottleneck at 64
         agents) becomes one order-free batched merge; equivalence-tolerance
         test in ``tests/test_normalization.py``."""
+        params = self._p(params)
         if self.cfg.fast_norm and self.cfg.obs_entity_mode:
             # statistics from the FACTORED form (O(A·F), exact up to
             # reassociation — normalization.welford_update_batch_factored);
@@ -259,15 +374,15 @@ class MultiAgvOffloadingEnv:
             # materialized raw matrix, but when no consumer reads it (the
             # entity-table acting + compact-storage stack) XLA dead-code
             # eliminates the whole O(A²) materialization from the rollout
-            rows, same_mec = self._entity_parts(state)
+            rows, same_mec = self._entity_parts(state, params)
             norm = select_update(
                 state.norm,
                 welford_update_batch_factored(state.norm, rows, same_mec),
                 update_norm)
-            obs = apply_norm(norm, self._raw_obs(state))
+            obs = apply_norm(norm, self._raw_obs(state, params))
             return state.replace(norm=norm), obs
 
-        raw = self._raw_obs(state)
+        raw = self._raw_obs(state, params)
 
         if self.cfg.fast_norm:
             norm, obs = normalize_batch(state.norm, raw, update=update_norm)
@@ -280,7 +395,8 @@ class MultiAgvOffloadingEnv:
         norm, obs = jax.lax.scan(body, state.norm, raw)
         return state.replace(norm=norm), obs
 
-    def compact_obs(self, state: EnvState
+    def compact_obs(self, state: EnvState,
+                    params: "EnvParams | None" = None
                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
                                jnp.ndarray]:
         """Factored form of the entity observation for the entity-table
@@ -298,20 +414,22 @@ class MultiAgvOffloadingEnv:
         valid for ``obs_entity_mode`` + ``fast_norm`` (the sequential
         normalizer gives each agent different prefix statistics)."""
         assert self.cfg.obs_entity_mode and self.cfg.fast_norm
-        rows, same_mec = self._entity_parts(state)
+        rows, same_mec = self._entity_parts(state, self._p(params))
         a = self.n_agents
         mean = state.norm.mean.reshape(a, self.obs_entity_feats)
         std = state.norm.std.reshape(a, self.obs_entity_feats)
         return rows, same_mec, mean, std
 
-    def get_state(self, state: EnvState) -> jnp.ndarray:
+    def get_state(self, state: EnvState,
+                  params: "EnvParams | None" = None) -> jnp.ndarray:
         """Global state: all-agent ACK one-hots ++ all-agent agent_inf rows,
         flattened (reference ``get_state`` :188-204); not normalized. With
         ``state_last_action`` the per-agent action one-hots are prepended —
         the reference declares the flag (:11) and keeps the concat slot
         commented (:196); wiring it preserves that config surface."""
+        params = self._p(params)
         ack1h = self._ack_onehot(state.last_ack)
-        inf = self._agent_inf(state)
+        inf = self._agent_inf(state, params)
         parts = [ack1h.reshape(-1), inf.reshape(-1)]
         if self.cfg.state_last_action:
             # M15 OneHot: the reference stores np.eye(n_actions)[actions]
@@ -320,9 +438,14 @@ class MultiAgvOffloadingEnv:
                                     self.n_actions).reshape(-1))
         return jnp.concatenate(parts)
 
-    def get_avail_actions(self, state: EnvState) -> jnp.ndarray:
+    def get_avail_actions(self, state: EnvState,
+                          params: "EnvParams | None" = None) -> jnp.ndarray:
         """(A, n_actions) availability (reference :61-82): empty buffer ⇒ only
-        action 0; ``edge_only`` forbids local compute when a job exists."""
+        action 0; ``edge_only`` forbids local compute when a job exists.
+        Padded agents are masked to action 0 EVERYWHERE — they can never
+        hold a job (the generator is mask-gated), but the explicit mask
+        pins the invariant against any future job-path change."""
+        params = self._p(params)
         has_job = state.job_valid[:, 0]
         idle_only = jnp.concatenate(
             [jnp.ones((self.n_agents, 1)),
@@ -333,22 +456,36 @@ class MultiAgvOffloadingEnv:
                  jnp.ones((self.n_agents, self.n_actions - 1))], axis=1)
         else:
             busy = jnp.ones((self.n_agents, self.n_actions))
-        return jnp.where(has_job[:, None], busy, idle_only).astype(jnp.int32)
+        avail = jnp.where(has_job[:, None], busy, idle_only)
+        mask = params.agent_mask(self.n_agents)
+        return jnp.where(mask[:, None], avail, idle_only).astype(jnp.int32)
 
-    def get_critic_score(self, state: EnvState, key: jax.Array) -> jnp.ndarray:
+    def get_critic_score(self, state: EnvState, key: jax.Array,
+                         params: "EnvParams | None" = None) -> jnp.ndarray:
         """CRITIC indicator matrix [task_prior, queueing-delay ratio,
         buffer-fill ratio] (+1e-6-scale noise) → per-agent scores (reference
         ``get_critic_score`` :84-104). ``task_prior`` is 1.0 for all AGVs in
         the released slice's single-type fleet (docs/SPEC.md); queueing delay
-        is ``latency_max - remaining_deadline`` of the head job."""
+        is ``latency_max - remaining_deadline`` of the head job. The
+        queueing-delay ratio is against the instance's deadline budget
+        (``params.deadline_ms``, = latency_max at default); the fill
+        ratio keeps the STATIC latency_max — it is the queue-capacity
+        bound, a shape property. Padded agents score zero through the
+        has-job gate (they never hold a job)."""
+        params = self._p(params)
         has_job = state.job_valid[:, 0]
-        lm = self.cfg.latency_max_ms
+        lm = params.deadline_ms
         prior = jnp.where(has_job, 1.0, 0.0)
+        # reciprocal-multiply, not division: XLA lowers the reference's
+        # divide-by-constant-lm to exactly this form, so the traced-lm
+        # default stays bit-identical (tests/test_graftworld.py goldens)
         delay_q = jnp.where(has_job,
-                            (lm - state.job_deadline[:, 0]) / lm, 0.0)
+                            (lm - state.job_deadline[:, 0]) * (1.0 / lm),
+                            0.0)
         fill = jnp.where(
             has_job,
-            state.job_valid.sum(axis=1) / (lm / self.t_length + 1), 0.0)
+            state.job_valid.sum(axis=1)
+            / (self.cfg.latency_max_ms / self.t_length + 1), 0.0)
         mat = jnp.stack([prior, delay_q, fill], axis=1)
         noise = 1e-6 * _round(jax.random.uniform(
             key, mat.shape, minval=0.9, maxval=1.1), 2)
@@ -356,13 +493,25 @@ class MultiAgvOffloadingEnv:
 
     # ------------------------------------------------------------------ queues
 
-    def _generate_jobs(self, state: EnvState, key: jax.Array) -> EnvState:
+    def _generate_jobs(self, state: EnvState, key: jax.Array,
+                       params: EnvParams) -> EnvState:
         """``AGV.generate_job`` (M1 spec): with prob ``job_prob`` append a job
-        ``(data ~ U[min,max] bits, deadline = latency_max)``; count it in
-        ``task_num``."""
+        ``(data ~ U[min,max] bits, deadline = params.deadline_ms)``; count it
+        in ``task_num``. graftworld regime knobs: the arrival rate is the
+        instance's ``job_prob`` modulated by a sinusoidal surge
+        (non-stationary traffic; ``amp=0`` multiplies by exactly 1), and
+        padded agents never generate (mask-gated). Defaults keep the
+        Bernoulli draw bit-identical — same uniform draw, same threshold
+        value."""
         k1, k2 = jax.random.split(key)
         a, j = self.n_agents, self.max_jobs
-        gen = jax.random.bernoulli(k1, self.cfg.job_prob, (a,))
+        p_eff = jnp.clip(
+            params.job_prob
+            * (1.0 + params.surge_amp
+               * jnp.sin(2.0 * np.pi * state.time_slot.astype(jnp.float32)
+                         / params.surge_period)), 0.0, 1.0)
+        gen = jax.random.bernoulli(k1, p_eff, (a,)) \
+            & params.agent_mask(a)
         data_new = jax.random.uniform(
             k2, (a,), minval=self.cfg.data_size_min,
             maxval=self.cfg.data_size_max)
@@ -371,23 +520,50 @@ class MultiAgvOffloadingEnv:
             & (cnt[:, None] < j)
         return state.replace(
             job_data=jnp.where(slot, data_new[:, None], state.job_data),
-            job_deadline=jnp.where(slot, self.cfg.latency_max_ms,
+            job_deadline=jnp.where(slot, params.deadline_ms,
                                    state.job_deadline),
             job_valid=state.job_valid | slot,
             task_num=state.task_num + gen.astype(jnp.int32),
         )
 
+    def _pad_sentinel(self, mec_index: jnp.ndarray,
+                      params: EnvParams) -> jnp.ndarray:
+        """Give every padded agent a UNIQUE negative serving-MEC index.
+        One representation covers every padding consumer: the same-MEC
+        equality mask makes padded agents visible only to themselves (and
+        the compact-entity store reconstructs the identical visibility
+        from the stored ``mec_index`` with no schema change), and the
+        collision histogram's ``one_hot`` maps out-of-range indices to
+        zero rows, so padded agents never occupy a channel or count
+        toward utilization. All-active (the default) selects the real
+        indices bit-identically."""
+        a = self.n_agents
+        return jnp.where(params.agent_mask(a), mec_index,
+                         -1 - jnp.arange(a, dtype=mec_index.dtype))
+
     def _update_users(self, state: EnvState, ack: jnp.ndarray,
-                      key: jax.Array) -> EnvState:
+                      key: jax.Array, params: EnvParams) -> EnvState:
         """``update_users`` per agent (reference :295-307), vectorized:
         teleport mobility (Q6), then pop head on ACK≠−1, age all deadlines by
         5 ms, drop expired, maybe generate. Ordering is load-bearing
-        (SURVEY.md §7.4(1))."""
+        (SURVEY.md §7.4(1)). graftworld mobility: each agent teleports with
+        ``params.teleport_prob`` (1.0 = the reference's unconditional
+        teleport — the gate draw comes from a ``fold_in`` side key, so the
+        reference key stream and the selected values are bit-identical)."""
         k_mec, k_pos, k_gen = jax.random.split(key, 3)
 
-        # Q6: i.i.d. teleport, serving MEC redrawn uniformly
+        # Q6: i.i.d. teleport, serving MEC redrawn uniformly. The teleport
+        # gate key is folded off the parent key, NOT split from it — a
+        # fourth split would re-pair the threefry counters and change
+        # every draw above even at the default
         new_mec = jax.random.randint(k_mec, (self.n_agents,), 0, self.n_mec)
-        new_pos = self._random_positions(k_pos, new_mec)
+        new_pos = self._random_positions(k_pos, new_mec, params)
+        tel = jax.random.uniform(
+            jax.random.fold_in(key, 7), (self.n_agents,)) \
+            < params.teleport_prob
+        new_mec = jnp.where(tel, new_mec, state.mec_index)
+        new_pos = jnp.where(tel[:, None], new_pos, state.pos)
+        new_mec = self._pad_sentinel(new_mec, params)
 
         # pop head job where ACK != -1 (local compute or successful offload)
         popped = (ack != -1) & state.job_valid[:, 0]
@@ -424,23 +600,28 @@ class MultiAgvOffloadingEnv:
 
         state = state.replace(mec_index=new_mec, pos=new_pos, job_data=data,
                               job_deadline=deadline, job_valid=valid)
-        return self._generate_jobs(state, k_gen)
+        return self._generate_jobs(state, k_gen, params)
 
     # ------------------------------------------------------------------ reward
 
-    def _reward(self, state: EnvState, ack: jnp.ndarray
+    def _reward(self, state: EnvState, ack: jnp.ndarray, params: EnvParams
                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, EnvState]:
         """Reference ``get_reward`` (:229-293), vectorized over the six
         branches. Uses pre-teleport positions and pre-update queues. Also
         applies the task_success/remain_delay counter side-effects the
-        reference performs inside the reward pass."""
+        reference performs inside the reward pass. Padded agents
+        contribute exactly zero: they never hold a job, so every branch
+        mask is False for them. The per-miss penalty and the completion-
+        delay bookkeeping use the instance's deadline budget
+        (``params.deadline_ms`` — the value every job was stamped with)."""
         has_job = state.job_valid[:, 0]
         data = state.job_data[:, 0]
         deadline = state.job_deadline[:, 0]
-        lm = self.cfg.latency_max_ms
+        lm = params.deadline_ms
 
-        local_delay = self._local_delay(data, 2)              # round(x, 2)
-        offload_delay = self._offload_delay(data, state.pos, state.mec_index)
+        local_delay = self._local_delay(data, 2, params)      # round(x, 2)
+        offload_delay = self._offload_delay(data, state.pos,
+                                            state.mec_index, params)
 
         is_local = has_job & (ack == 0)
         is_collision = has_job & (ack == -1)
@@ -469,20 +650,25 @@ class MultiAgvOffloadingEnv:
 
     # ------------------------------------------------------------------ API
 
-    def reset(self, key: jax.Array, norm: NormState | None = None
+    def reset(self, key: jax.Array, norm: NormState | None = None,
+              params: "EnvParams | None" = None
               ) -> Tuple[EnvState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """→ (state, obs, global_state, avail_actions). Mirrors reference
         ``reset``/``reset_user`` (:206-227): fresh positions, empty buffers,
         one ``generate_job`` call, zero ACK/last_action; obs normalizer
         persists across resets (it lives for the life of the subprocess in
-        the reference — pass the previous episode's ``norm`` to carry it)."""
+        the reference — pass the previous episode's ``norm`` to carry it).
+        ``params`` selects the scenario instance (graftworld, docs/ENVS.md);
+        None = the fixed default scenario, bit-identical to pre-graftworld."""
+        params = self._p(params)
         k_mec, k_pos, k_gen = jax.random.split(key, 3)
         a, j = self.n_agents, self.max_jobs
-        mec_index = jax.random.randint(k_mec, (a,), 0, self.n_mec)
+        mec_index = self._pad_sentinel(
+            jax.random.randint(k_mec, (a,), 0, self.n_mec), params)
         state = EnvState(
             time_slot=jnp.zeros((), jnp.int32),
             mec_index=mec_index,
-            pos=self._random_positions(k_pos, mec_index),
+            pos=self._random_positions(k_pos, mec_index, params),
             job_data=jnp.zeros((a, j), jnp.float32),
             job_deadline=jnp.zeros((a, j), jnp.float32),
             job_valid=jnp.zeros((a, j), bool),
@@ -493,22 +679,27 @@ class MultiAgvOffloadingEnv:
             remain_delay=jnp.zeros((a,), jnp.float32),
             norm=NormState.create(self.obs_dim) if norm is None else norm,
         )
-        state = self._generate_jobs(state, k_gen)
-        state, obs = self.get_obs(state)
-        return state, obs, self.get_state(state), self.get_avail_actions(state)
+        state = self._generate_jobs(state, k_gen, params)
+        state, obs = self.get_obs(state, params)
+        return (state, obs, self.get_state(state, params),
+                self.get_avail_actions(state, params))
 
     def fresh_norm(self, state: EnvState) -> EnvState:
         return state.replace(norm=NormState.create(self.obs_dim))
 
     def step(self, state: EnvState, actions: jnp.ndarray, key: jax.Array,
-             update_norm: bool = True
+             params: "EnvParams | None" = None, update_norm: bool = True
              ) -> Tuple[EnvState, jnp.ndarray, jnp.ndarray, StepInfo,
                         jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """→ (state', reward, terminated, info, obs', global_state', avail').
 
         The reference worker protocol returns next-step obs/state/avail with
         the current-step reward (``parallel_runner.py:247-256``); this fuses
-        both into one call."""
+        both into one call. ``params`` is the lane's scenario instance —
+        constant through the episode, resampled at reset by the runner's
+        scenario distribution (graftworld)."""
+        params = self._p(params)
+        mask = params.agent_mask(self.n_agents)
         actions = actions.astype(jnp.int32)
 
         # per-MEC collision resolution (reference :319-326; Q14). The
@@ -527,10 +718,18 @@ class MultiAgvOffloadingEnv:
         chosen = masked[state.mec_index, actions]
         # explicit int32: a weak-typed ack in the carried state would give
         # the rollout program weak output avals and force a second compile
-        # when the driver chains the state back in
+        # when the driver chains the state back in. Padded agents are
+        # pinned to ack 0 — their sentinel mec_index wraps the histogram
+        # gather, so the raw lookup could read any row
         ack = jnp.where(actions == 0, 0,
                         jnp.where(chosen == 1, 1, -1)).astype(jnp.int32)
-        conflict_ratio = (ack == -1).mean()
+        ack = jnp.where(mask, ack, 0)
+        # reciprocal-multiply over the ACTIVE count: the reference's
+        # ``.mean()`` lowers div-by-constant-A to exactly this form, so
+        # the all-active default is bit-identical while padded scenarios
+        # divide by the true fleet size
+        conflict_ratio = (ack == -1).astype(jnp.float32).sum() \
+            * (1.0 / params.n_active.astype(jnp.float32))
 
         state = state.replace(
             time_slot=state.time_slot + 1,
@@ -538,12 +737,18 @@ class MultiAgvOffloadingEnv:
             last_ack=ack,
         )
 
-        reward, delay_reward, overtime, state = self._reward(state, ack)
-        state = self._update_users(state, ack, key)
+        reward, delay_reward, overtime, state = self._reward(state, ack,
+                                                             params)
+        state = self._update_users(state, ack, key, params)
 
         terminated = state.time_slot >= self.cfg.episode_limit
         tn = state.task_num.sum()
         ts = state.task_success.sum()
+        # deadline misses = generated − completed-in-deadline − still
+        # queued: late local/offload completions and queue-expired drops
+        # each leave the queue exactly once, so each missed job is
+        # counted exactly once (per-slice eval metric, docs/ENVS.md)
+        queued = state.job_valid.sum()
         info = StepInfo(
             reward=reward,
             delay_reward=delay_reward,
@@ -554,11 +759,13 @@ class MultiAgvOffloadingEnv:
             task_completion_rate=ts / jnp.maximum(tn, 1),
             task_completion_delay=state.remain_delay.sum()
             / jnp.maximum(ts, 1),
+            deadline_miss_rate=(tn - ts - queued) / jnp.maximum(tn, 1),
         )
 
-        state, obs = self.get_obs(state, update_norm=update_norm)
+        state, obs = self.get_obs(state, params, update_norm=update_norm)
         return (state, reward, terminated, info, obs,
-                self.get_state(state), self.get_avail_actions(state))
+                self.get_state(state, params),
+                self.get_avail_actions(state, params))
 
     def get_env_info(self) -> Dict[str, int]:
         """Reference ``get_env_info`` (:421-439); copied onto args by the

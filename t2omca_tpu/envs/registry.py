@@ -1,27 +1,115 @@
-"""Environment registry (M3).
+"""Environment registry (M3 + graftworld scenario families).
 
 The reference runner builds envs through ``envs.REGISTRY[name](**env_args)``
-(``/root/reference/parallel_runner.py:1,22``); here the registry maps names to
-functional-env constructors taking an ``EnvConfig``.
+(``/root/reference/parallel_runner.py:1,22``); here the registry maps names
+to :class:`EnvEntry` records — a functional-env constructor PLUS the env
+key's default scenario (a ``config.ScenarioConfig``), so a registry key is
+a (physics, parameter-distribution) pair. The scenario families
+(``envs/graftworld.py``) share the ONE MEC-offload ``step``: each family
+key selects a different default EnvParams distribution, not different
+code. Aliases are declared per entry and deduped into one canonical map —
+an alias and its canonical key resolve to the identical entry object.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict
+import dataclasses
+from typing import Callable, Dict, Tuple
 
-from ..config import EnvConfig
+from ..config import EnvConfig, ScenarioConfig
 from .mec_offload import MultiAgvOffloadingEnv
 
-REGISTRY: Dict[str, Callable[[EnvConfig], MultiAgvOffloadingEnv]] = {
-    "multi_agv_offloading": MultiAgvOffloadingEnv,
-    "multi_mec": MultiAgvOffloadingEnv,   # reference map_name alias
+
+@dataclasses.dataclass(frozen=True)
+class EnvEntry:
+    """One registered env key: constructor + default scenario + aliases."""
+
+    ctor: Callable[[EnvConfig], MultiAgvOffloadingEnv]
+    default_scenario: ScenarioConfig = dataclasses.field(
+        default_factory=ScenarioConfig)
+    aliases: Tuple[str, ...] = ()
+
+
+REGISTRY: Dict[str, EnvEntry] = {
+    # the reference scenario: fixed baseline parameters
+    "multi_agv_offloading": EnvEntry(
+        MultiAgvOffloadingEnv,
+        ScenarioConfig(kind="fixed", family="baseline"),
+        aliases=("multi_mec",)),        # reference map_name alias
+    # graftworld families (docs/ENVS.md): same physics/step, different
+    # default parameter distributions
+    "multi_agv_hetfleet": EnvEntry(
+        MultiAgvOffloadingEnv,
+        ScenarioConfig(kind="uniform", family="hetfleet"),
+        aliases=("hetfleet",)),
+    "multi_agv_interference": EnvEntry(
+        MultiAgvOffloadingEnv,
+        ScenarioConfig(kind="uniform", family="interference"),
+        aliases=("interference",)),
+    "multi_agv_surge": EnvEntry(
+        MultiAgvOffloadingEnv,
+        ScenarioConfig(kind="uniform", family="surge"),
+        aliases=("surge",)),
+    # the full domain-randomized mixture over every family
+    "multi_agv_scenarios": EnvEntry(
+        MultiAgvOffloadingEnv,
+        ScenarioConfig(kind="mixture"),
+        aliases=("scenarios", "graftworld")),
 }
 
 
-def make_env(cfg: EnvConfig) -> MultiAgvOffloadingEnv:
+def _alias_map() -> Dict[str, str]:
+    """alias -> canonical key, built once from the entries (single
+    source: an alias is declared exactly where its entry is)."""
+    amap: Dict[str, str] = {}
+    for canonical, entry in REGISTRY.items():
+        for alias in entry.aliases:
+            if alias in REGISTRY or alias in amap:
+                raise ValueError(f"env alias {alias!r} collides with an "
+                                 f"existing key/alias")
+            amap[alias] = canonical
+    return amap
+
+
+ALIASES: Dict[str, str] = _alias_map()
+
+
+def resolve(key: str) -> Tuple[str, EnvEntry]:
+    """→ (canonical key, entry); canonical keys and aliases both resolve.
+    The unknown-key error names canonical keys and aliases separately —
+    a typo'd alias should not read as 'not one of the canonical four'."""
+    canonical = ALIASES.get(key, key)
     try:
-        ctor = REGISTRY[cfg.key]
+        return canonical, REGISTRY[canonical]
     except KeyError:
         raise KeyError(
-            f"unknown env '{cfg.key}'; registered: {sorted(REGISTRY)}")
-    return ctor(cfg)
+            f"unknown env '{key}'; canonical keys: {sorted(REGISTRY)}; "
+            f"aliases: "
+            f"{sorted(f'{a} -> {c}' for a, c in ALIASES.items())}"
+        ) from None
+
+
+def make_env(cfg: EnvConfig) -> MultiAgvOffloadingEnv:
+    _, entry = resolve(cfg.key)
+    return entry.ctor(cfg)
+
+
+def scenario_config(cfg: EnvConfig) -> ScenarioConfig:
+    """The effective scenario for an env config: an explicit
+    ``env_args.scenario.kind`` wins; the empty-kind sentinel (the
+    untouched default) falls back to the registry key's default — so
+    ``key: multi_agv_surge`` alone trains over the surge envelope,
+    while ``key: multi_agv_offloading`` + ``scenario: {kind: mixture}``
+    overrides it, and ``kind: fixed`` over a family key explicitly
+    pins the baseline point."""
+    _, entry = resolve(cfg.key)
+    if cfg.scenario.kind:
+        return cfg.scenario
+    return entry.default_scenario
+
+
+def make_scenario_distribution(cfg: EnvConfig):
+    """→ the ``graftworld.ScenarioDistribution`` the runner samples each
+    lane's EnvParams from (jit-static; one per config)."""
+    from .graftworld import make_distribution
+    return make_distribution(scenario_config(cfg))

@@ -55,6 +55,23 @@ PHASE_PROGRAMS = {
 }
 
 
+#: scenario-family id -> name (graftworld per-slice eval). MIRRORED from
+#: ``envs/graftworld.FAMILY_NAMES`` — this module must stay jax-free
+#: (the post-mortem host may not even initialize a backend), and
+#: graftworld imports jax for its samplers. Pinned against the source
+#: tuple by tests/test_graftworld.py.
+SCENARIO_FAMILY_NAMES = ("baseline", "hetfleet", "interference", "surge")
+
+#: per-slice metric columns, (header, metrics-key) in render order:
+#: the return plus utils/stats.SLICE_KEYS — pinned against SLICE_KEYS
+#: by tests/test_graftworld.py (same mirror-and-pin policy as the
+#: family names; this module must not import the jax-adjacent stats)
+SLICE_METRICS = (("return", "return_mean"),
+                 ("conflict", "conflict_ratio_mean"),
+                 ("complete", "task_completion_rate_mean"),
+                 ("dl-miss", "deadline_miss_rate_mean"))
+
+
 def load_events(run_dir: str) -> List[dict]:
     path = os.path.join(run_dir, "spans.jsonl")
     events: List[dict] = []
@@ -77,6 +94,78 @@ def load_device_times(run_dir: str) -> Dict[str, dict]:
             return dict(json.load(f).get("programs", {}))
     except (OSError, ValueError):
         return {}
+
+
+def scenario_slices(run_dir: str) -> Dict[str, Dict[int, dict]]:
+    """Per-scenario-slice eval metrics from the run's ``metrics.jsonl``
+    (graftworld, docs/ENVS.md): the newest value of every
+    ``[test_]slice<fam>_*`` key the stats accumulators logged, grouped
+    as ``{prefix: {family_id: {metric: value}}}``. Empty when the run
+    trained a single scenario (the accumulators only emit slice rows
+    when more than one family was observed)."""
+    path = os.path.join(run_dir, "metrics.jsonl")
+    out: Dict[str, Dict[int, dict]] = {}
+    try:
+        f = open(path)
+    except OSError:
+        return out
+    with f:
+        for line in f:
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue            # torn final line
+            key = ev.get("key", "")
+            prefix = ""
+            if key.startswith("test_"):
+                prefix, key = "test", key[5:]
+            if not key.startswith("slice"):
+                continue
+            fam_s, _, metric = key[5:].partition("_")
+            if not fam_s.isdigit() or not metric:
+                continue
+            out.setdefault(prefix, {}).setdefault(
+                int(fam_s), {})[metric] = ev.get("value")
+    return out
+
+
+def render_slices(slices: Dict[str, Dict[int, dict]]) -> List[str]:
+    """The per-scenario-slice table: one block per train/test prefix,
+    one row per family — the generalization read ISSUE 11 asks for
+    (mean return alone hides a family the policy sacrificed)."""
+
+    def cell(v, nd=1):
+        # NOT _fmt: that helper renders negatives as '-' (its callers
+        # use -1 as an absent sentinel), but slice returns are routinely
+        # negative (reward = delay gain - deadline penalties) and the
+        # worst families are exactly the rows this table exists to show
+        if v is None:
+            return "-"
+        return f"{v:,.{nd}f}" if isinstance(v, float) else str(v)
+
+    lines: List[str] = []
+    for prefix in sorted(slices):
+        fams = slices[prefix]
+        if not fams:
+            continue
+        lines.append("")
+        lines.append(f"scenario slices ({prefix or 'train'}; newest "
+                     f"cadence, graftworld per-family eval)")
+        hdr = f"{'family':<16}{'n':>7}" + "".join(
+            f"{label:>11}" for label, _ in SLICE_METRICS)
+        lines.append(hdr)
+        lines.append("-" * len(hdr))
+        for fam in sorted(fams):
+            m = fams[fam]
+            name = (SCENARIO_FAMILY_NAMES[fam]
+                    if 0 <= fam < len(SCENARIO_FAMILY_NAMES)
+                    else f"family{fam}")
+            row = f"{name:<16}{cell(m.get('n'), 0):>7}"
+            for label, key in SLICE_METRICS:
+                nd = 1 if key == "return_mean" else 3
+                row += f"{cell(m.get(key), nd):>11}"
+            lines.append(row)
+    return lines
 
 
 def run_header(events: List[dict]) -> Optional[dict]:
@@ -313,6 +402,7 @@ def render(run_dir: str, events: List[dict], rows: List[dict],
                      "starvation); params.sync mixes the learner "
                      "publish with the actor's staleness wait and is "
                      "counted on neither side")
+    lines.extend(render_slices(scenario_slices(run_dir)))
     return "\n".join(lines)
 
 

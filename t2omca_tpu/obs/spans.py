@@ -87,9 +87,13 @@ KNOWN_PHASES = frozenset({
     "params.sync",
     # checkpoint + startup boundaries
     "checkpoint.save", "collective.gather", "backend.init",
-    # bench.py phases (bench harness spans; embedded in BENCH_r*.json)
-    "bench.probe", "bench.build", "bench.compile", "bench.warm",
-    "bench.measure",
+    # bench.py phases (bench harness spans; embedded in BENCH_r*.json).
+    # bench.probe is the RETRYABLE backend-init phase (per-attempt
+    # budget split + backoff ladder); bench.probe.fallback is the
+    # JAX_PLATFORMS='' auto-fallback probe that runs after it fails —
+    # its outcome lands in the failure record's `fallback` block
+    "bench.probe", "bench.probe.fallback", "bench.build",
+    "bench.compile", "bench.warm", "bench.measure",
     # graftserve boundaries (serve/export.py, serve/frontend.py): the
     # exporter's lower/compile/export pass, artifact load, and the
     # three per-request front-end stages — `obs report` reads a

@@ -116,7 +116,10 @@ class DataParallel:
             # reward-scale state is per-lane except the scalar Welford count
             rscale=jax.tree.map(
                 lambda x: lane if getattr(x, "ndim", 0) else rep,
-                ts_like.runner.rscale))
+                ts_like.runner.rscale),
+            # graftworld scenario instances: every EnvParams leaf is
+            # batched (B, ...) — sharded with its env lane
+            env_params=fill(ts_like.runner.env_params, lane))
         buffer = ts_like.buffer.replace(
             storage=fill(ts_like.buffer.storage, lane),
             insert_pos=rep, episodes_in_buffer=rep,
@@ -203,7 +206,9 @@ class DataParallel:
                 key=wsc(rs.key, rep),
                 t_env=wsc(rs.t_env, rep),
                 rscale=jax.tree.map(
-                    lambda x: wsc(x, data if x.ndim else rep), rs.rscale))
+                    lambda x: wsc(x, data if x.ndim else rep), rs.rscale),
+                env_params=jax.tree.map(lambda x: wsc(x, data),
+                                        rs.env_params))
 
         def constrain_buffer(buf):
             return buf.replace(

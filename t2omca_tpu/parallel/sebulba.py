@@ -159,7 +159,10 @@ class Sebulba:
             key=rep, t_env=rep,
             rscale=jax.tree.map(
                 lambda x: lane if getattr(x, "ndim", 0) else rep,
-                rs_like.rscale))
+                rs_like.rscale),
+            # graftworld scenario instances shard with their env lanes
+            # (every EnvParams leaf is batched (B, ...))
+            env_params=jax.tree.map(lambda _: lane, rs_like.env_params))
 
     def learner_shardings(self, ls_like):
         """Learner-mesh placement: params/opt replicated (grads psum'd by

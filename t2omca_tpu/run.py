@@ -698,11 +698,13 @@ def run_sequential(exp: Experiment, logger: Logger,
     # run header for the report CLI: the shapes that scale graftprog's
     # audit-config budgets to this run (obs/report.py)
     if rec.enabled:
+        from .envs.registry import scenario_config
         rec.mark("run", t_env=t_env, backend=jax.default_backend(),
                  batch_size_run=cfg.batch_size_run,
                  episode_limit=cfg.env_args.episode_limit,
                  batch_size=cfg.batch_size, superstep=K,
-                 host_buffer=exp.host_buffer)
+                 host_buffer=exp.host_buffer,
+                 scenario=scenario_config(cfg.env_args).kind)
     # per-stage barriers for honest attribution; tracing implies them
     # (an un-synced trace window would capture dispatch, not execution)
     sync_stages = cfg.profile_stages or bool(cfg.profile_dir)

@@ -83,6 +83,7 @@ class EpisodeRunner:
             "conflict_ratio": lane(info.conflict_ratio),
             "task_completion_rate": lane(info.task_completion_rate),
             "task_completion_delay": lane(info.task_completion_delay),
+            "deadline_miss_rate": lane(info.deadline_miss_rate),
             "mec_positions": np.asarray(self.env.mec_positions()),
             "radius": np.asarray(self.env.cfg.communication_range_m),
         }
@@ -161,6 +162,9 @@ class EpisodeRunner:
                     float(traj["task_completion_rate"][-1]),
                 "task_completion_delay":
                     float(traj["task_completion_delay"][-1]),
+                "deadline_miss_rate":
+                    float(traj.get("deadline_miss_rate",
+                                   np.zeros(1))[-1]),
             })
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         pd.DataFrame(rows).to_csv(path, index=False)

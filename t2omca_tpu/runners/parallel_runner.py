@@ -41,9 +41,17 @@ from flax import struct
 from ..components.episode_buffer import CompactEntityObs, TimeMajorEpisodes
 from ..config import TrainConfig
 from ..controllers.basic_mac import BasicMAC
-from ..envs.mec_offload import EnvState, MultiAgvOffloadingEnv
+from ..envs.mec_offload import EnvParams, EnvState, MultiAgvOffloadingEnv
 from ..envs.normalization import (RewardScaleState, reset_reward_scale,
                                   scale_reward)
+from ..envs.registry import make_scenario_distribution
+
+#: fold_in salt for the per-rollout scenario-sampling key: the sampler
+#: key is folded OFF the rollout key, never split from it — splitting
+#: would re-pair the threefry counters of the existing reset/scan split
+#: and silently change every env stream even for the fixed default
+#: scenario (the graftworld bit-parity contract, tests/test_graftworld.py)
+_SCENARIO_SALT = 0x5CE7
 
 
 @struct.dataclass
@@ -57,6 +65,13 @@ class RunnerState:
     # active only under env_args.reward_scaling, but always carried so the
     # checkpoint pytree is config-independent)
     rscale: RewardScaleState
+    # per-lane scenario instances (graftworld EnvParams, batched (B, ...)):
+    # the knobs the CURRENT episode of each lane runs under, resampled
+    # from the config's ScenarioDistribution at every rollout start.
+    # Carried so (a) checkpoints record the active scenarios, (b) the
+    # data-parallel/sebulba placement rules shard them with their lanes
+    # (parallel/mesh.py, parallel/sebulba.py)
+    env_params: EnvParams
 
 
 @struct.dataclass
@@ -79,7 +94,12 @@ class RolloutStats:
     episode_limit: jnp.ndarray             # (B,) terminated-by-time-limit
     task_completion_rate: jnp.ndarray      # (B,)
     task_completion_delay: jnp.ndarray     # (B,)
+    deadline_miss_rate: jnp.ndarray        # (B,)
     epsilon: jnp.ndarray                   # ()
+    # per-lane scenario-family tag (graftworld): which family slice each
+    # episode ran under — the stats accumulators group the terminal-info
+    # aggregation by it (per-slice generalization eval, utils/stats.py)
+    scenario: jnp.ndarray                  # (B,) int32
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,6 +122,23 @@ class ParallelRunner:
     def get_env_info(self) -> Dict[str, int]:
         return self.env.get_env_info()
 
+    @property
+    def scenario(self):
+        """The config's scenario distribution (graftworld) — a frozen,
+        hashable dataclass the jitted rollout closes over as static
+        structure; built on demand (cheap: pure dataclass assembly)."""
+        return make_scenario_distribution(self.cfg.env_args)
+
+    def _sample_scenarios(self, key: jax.Array) -> EnvParams:
+        """One EnvParams instance per lane, from a ``fold_in`` side key
+        (see ``_SCENARIO_SALT``): each lane draws its own scenario with
+        zero extra dispatches — the sampling is part of the rollout
+        program."""
+        scn = self.scenario
+        keys = jax.random.split(
+            jax.random.fold_in(key, _SCENARIO_SALT), self.batch_size)
+        return jax.vmap(lambda k: scn.sample(k, self.env))(keys)
+
     # ------------------------------------------------------------------ state
 
     def init_state(self, key: jax.Array) -> RunnerState:
@@ -112,13 +149,15 @@ class ParallelRunner:
         different worlds)."""
         key = jax.random.fold_in(key, self.cfg.env_args.seed)
         key, k_reset = jax.random.split(key)
+        env_params = self._sample_scenarios(k_reset)
         states, *_ = jax.vmap(self.env.reset)(
-            jax.random.split(k_reset, self.batch_size))
+            jax.random.split(k_reset, self.batch_size), None, env_params)
         return RunnerState(
             env_states=states, key=key,
             t_env=jnp.zeros((), jnp.int32),
             rscale=RewardScaleState.create(gamma=self.cfg.gamma,
-                                           dim=self.batch_size))
+                                           dim=self.batch_size),
+            env_params=env_params)
 
     # ------------------------------------------------------------------ rollout
 
@@ -152,10 +191,17 @@ class ParallelRunner:
         # not once per scan step (no-op on other acting paths)
         params = self.mac.prepare_acting_params(params)
 
+        # graftworld: every lane samples a fresh scenario instance at
+        # episode start (per-lane EnvParams, one traced program for the
+        # whole distribution — fixed/uniform/mixture alike). The sampler
+        # key folds off rs.key so the env/action key streams are
+        # untouched (bit-parity at the fixed default scenario)
+        env_params = self._sample_scenarios(rs.key)
+
         # reset every lane, carrying each lane's Welford normalizer (Q4)
         reset_keys = jax.random.split(k_reset, b)
         env_states, obs, gstate, avail = jax.vmap(self.env.reset)(
-            reset_keys, rs.env_states.norm)
+            reset_keys, rs.env_states.norm, env_params)
 
         hidden = self.mac.init_hidden(b)
 
@@ -192,7 +238,8 @@ class ParallelRunner:
             # pure function of the carried env state (same post-update norm
             # stats the carried obs was normalized with), so recompute it
             # here instead of widening the carry
-            compact = (jax.vmap(self.env.compact_obs)(env_states)
+            compact = (jax.vmap(self.env.compact_obs)(env_states,
+                                                      env_params)
                        if self.mac.use_entity_tables or compact_store
                        else None)
             actions, hidden, eps = self.mac.select_actions(
@@ -209,7 +256,8 @@ class ParallelRunner:
                    if capture else None)
             env_states, reward, terminated, info, obs, gstate, avail = \
                 jax.vmap(self.env.step)(
-                    env_states, actions, jax.random.split(k_env, b))
+                    env_states, actions, jax.random.split(k_env, b),
+                    env_params)
             if scale_on:
                 rscale, rec_reward = scale_reward(rscale, reward)
             else:
@@ -230,7 +278,7 @@ class ParallelRunner:
         if compact_store:
             last_obs_store = obs_store(
                 env_states, last_obs,
-                jax.vmap(self.env.compact_obs)(env_states))
+                jax.vmap(self.env.compact_obs)(env_states, env_params))
         else:
             last_obs_store = last_obs.astype(sd)
         tm = TimeMajorEpisodes(
@@ -257,10 +305,13 @@ class ParallelRunner:
             episode_limit=last(info.episode_limit).astype(jnp.float32),
             task_completion_rate=last(info.task_completion_rate),
             task_completion_delay=last(info.task_completion_delay),
+            deadline_miss_rate=last(info.deadline_miss_rate),
             epsilon=eps[-1],
+            scenario=env_params.family,
         )
         new_rs = RunnerState(env_states=env_states, key=key, t_env=t_env,
-                             rscale=rscale if scale_on else rs.rscale)
+                             rscale=rscale if scale_on else rs.rscale,
+                             env_params=env_params)
         if capture:
             pos_seq, mec_seq, ack_seq = viz_seq
             viz = {"pos": pos_seq, "mec_index": mec_seq, "acks": ack_seq,

@@ -42,10 +42,12 @@ logger = logging.getLogger(__name__)
 
 #: bump when the checkpointed pytree layout changes incompatibly
 #: (v2: bool avail storage + meta sidecar; v3: RunnerState carries the
-#: per-lane reward-scale state). The staged/atomic write and the sidecar's
-#: ``sha256``/``bytes`` keys are ADDITIVE — the tree layout is unchanged
-#: and old readers ignore unknown sidecar keys, so they do not bump this.
-FORMAT_VERSION = 3
+#: per-lane reward-scale state; v4: RunnerState carries the per-lane
+#: graftworld scenario params, envs/mec_offload.EnvParams). The
+#: staged/atomic write and the sidecar's ``sha256``/``bytes`` keys are
+#: ADDITIVE — the tree layout is unchanged and old readers ignore
+#: unknown sidecar keys, so they do not bump this.
+FORMAT_VERSION = 4
 
 
 class CheckpointFormatError(ValueError):
@@ -413,27 +415,45 @@ def _check_obs_layout(meta: Optional[dict], target: Any,
             f"checkpoint (docs/SPEC.md perf modes)")
 
 
+def _inject_runner_field(raw: Any, target: Any, name: str) -> None:
+    """Inject the template's ``runner.<name>`` state-dict into a raw
+    tree missing the field (stepwise format migration). Abstract
+    template leaves (eval_shape restore) inject fresh zeros."""
+    if not (isinstance(raw, dict) and "runner" in raw
+            and name not in raw["runner"]):
+        return
+    import numpy as _np
+    host = jax.tree.map(
+        lambda x: (_np.zeros(x.shape, x.dtype)
+                   if isinstance(x, jax.ShapeDtypeStruct)
+                   else jax.device_get(x)),
+        getattr(target.runner, name))
+    raw["runner"][name] = serialization.to_state_dict(host)
+
+
 def _migrate_raw(meta: Optional[dict], raw: Any, target: Any) -> Any:
-    """v2 → v3 migration: v3 added RunnerState.rscale. No v2 run could
-    have had reward_scaling on (the field did not exist), so injecting
-    the template's reward-scale state-dict is lossless — replay
-    contents, normalizer stats, and RNG state all restore exactly.
+    """Stepwise format migrations, each lossless:
+
+    * v2 → v3 added ``RunnerState.rscale``. No v2 run could have had
+      reward_scaling on (the field did not exist), so injecting the
+      template's reward-scale state-dict restores replay contents,
+      normalizer stats, and RNG state exactly.
+    * v3 → v4 added ``RunnerState.env_params`` (graftworld scenario
+      instances, envs/mec_offload.EnvParams). The rollout RESAMPLES
+      env_params at every episode start, so the injected template
+      values (the caller's freshly-initialized scenario draw; zeros on
+      an eval_shape template) are consumed by nothing — a v3 run
+      restores into the v4 tree with identical training behavior.
+
     Meta-less checkpoints (pre-v2, or a deleted sidecar) take the same
     path: injection is conditional on the field actually being absent,
-    so a v3 tree without its meta.json still restores unmodified.
-    Abstract template leaves (eval_shape restore) inject fresh zeros —
-    value-identical to a fresh RunnerState's rscale."""
-    if meta is not None and meta.get("format", 0) >= 3:
-        return raw
-    if (isinstance(raw, dict) and "runner" in raw
-            and "rscale" not in raw["runner"]):
-        import numpy as _np
-        host = jax.tree.map(
-            lambda x: (_np.zeros(x.shape, x.dtype)
-                       if isinstance(x, jax.ShapeDtypeStruct)
-                       else jax.device_get(x)),
-            target.runner.rscale)
-        raw["runner"]["rscale"] = serialization.to_state_dict(host)
+    so a current-format tree without its meta.json still restores
+    unmodified."""
+    fmt = meta.get("format", 0) if meta is not None else 0
+    if fmt < 3:
+        _inject_runner_field(raw, target, "rscale")
+    if fmt < 4:
+        _inject_runner_field(raw, target, "env_params")
     return raw
 
 

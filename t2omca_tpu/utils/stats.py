@@ -25,12 +25,22 @@ import jax
 import numpy as np
 
 #: info keys present in the reference env's terminal-step info dict
-#: (``/root/reference/environment_multi_mec.py:343-364``)
+#: (``/root/reference/environment_multi_mec.py:343-364``), plus the
+#: graftworld deadline-miss rate (envs/mec_offload.StepInfo — the
+#: per-slice generalization metric, docs/ENVS.md)
 TERMINAL_INFO_KEYS = (
     "reward", "delay_reward", "overtime_penalty",
     "channel_utilization_rate", "conflict_ratio", "episode_limit",
     "task_completion_rate", "task_completion_delay",
+    "deadline_miss_rate",
 )
+
+#: per-slice keys worth a slice breakdown (graftworld per-scenario
+#: eval): return + the generalization-relevant rates — the full
+#: TERMINAL set per slice would triple the metric stream for keys
+#: (epsilon-like constants, episode_limit) that cannot differ by slice
+SLICE_KEYS = ("conflict_ratio", "task_completion_rate",
+              "deadline_miss_rate")
 
 
 class StatsAccumulator:
@@ -62,6 +72,11 @@ class StatsAccumulator:
         self._eps_val = 0.0         # cached host value
         self._returns: List[float] = []   # folded per-episode returns
         self._stats = defaultdict(float)  # folded terminal-info sums
+        # graftworld per-scenario-slice aggregation (docs/ENVS.md):
+        # family id -> {n, return_sum, <SLICE_KEYS sums>}; fed by the
+        # SAME fold fetch as the overall sums — a stats object without a
+        # ``scenario`` field (older tests, fakes) skips slice tracking
+        self._slices = defaultdict(lambda: defaultdict(float))
 
     def push(self, rollout_stats) -> None:
         self._pending.append(rollout_stats)
@@ -87,7 +102,24 @@ class StatsAccumulator:
             ret = np.asarray(s.episode_return).reshape(-1)
             self._returns.extend(float(x) for x in ret)
             for k in TERMINAL_INFO_KEYS:
-                self._stats[k] += float(np.sum(getattr(s, k)))
+                # absent keys (older fakes without the graftworld
+                # fields) simply don't aggregate
+                v = getattr(s, k, None)
+                if v is not None:
+                    self._stats[k] += float(np.sum(v))
+            scenario = getattr(s, "scenario", None)
+            if scenario is not None:
+                fam = np.asarray(scenario).reshape(-1).astype(np.int64)
+                for f in np.unique(fam):
+                    sel = fam == f
+                    sl = self._slices[int(f)]
+                    sl["n"] += float(sel.sum())
+                    sl["return"] += float(ret[sel].sum())
+                    for k in SLICE_KEYS:
+                        v = getattr(s, k, None)
+                        if v is not None:
+                            sl[k] += float(
+                                np.asarray(v).reshape(-1)[sel].sum())
         # the last pending entry owns the epsilon ref — same fetch; a
         # stacked push's most recent value is its LAST row
         self._eps_val = float(np.mean(
@@ -115,7 +147,11 @@ class StatsAccumulator:
 
     def flush(self, logger, t_env: int, prefix: str = "") -> None:
         """Log ``return_mean`` + every ``<k>_mean`` and clear
-        (``/root/reference/parallel_runner.py:222-231``)."""
+        (``/root/reference/parallel_runner.py:222-231``). When the
+        accumulated episodes span MORE than one scenario-family slice
+        (a graftworld distribution), per-slice rows follow under
+        ``<prefix>slice<fam>_*`` keys — single-scenario runs keep the
+        exact pre-graftworld metric stream."""
         self._fold()                              # ONE host round-trip
         if self._returns:
             logger.log_stat(prefix + "return_mean",
@@ -123,6 +159,17 @@ class StatsAccumulator:
         n = max(self.n_episodes, 1)
         for k, v in self._stats.items():
             logger.log_stat(prefix + k + "_mean", v / n, t_env)
+        if len(self._slices) > 1:
+            for fam in sorted(self._slices):
+                sl = self._slices[fam]
+                sn = max(sl["n"], 1.0)
+                tag = f"{prefix}slice{fam}_"
+                logger.log_stat(tag + "n", sl["n"], t_env)
+                logger.log_stat(tag + "return_mean", sl["return"] / sn,
+                                t_env)
+                for k in SLICE_KEYS:
+                    logger.log_stat(tag + k + "_mean", sl[k] / sn, t_env)
         self._returns.clear()
         self._stats.clear()
+        self._slices.clear()
         self.n_episodes = 0

@@ -138,8 +138,11 @@ def test_reward_offload_success_branch():
     out = env.step(st, jnp.asarray([1, 0, 0, 0]), KEY)
     st2, reward = out[0], out[1]
     ld = expected_local_delay(env, data)
-    od = float(env._offload_delay(jnp.asarray([data]), st.pos[:1],
-                                  st.mec_index[:1])[0])
+    p = env.default_params()
+    od = float(env._offload_delay(
+        jnp.asarray([data]), st.pos[:1], st.mec_index[:1],
+        p.replace(tx_scale=p.tx_scale[:1],
+                  compute_scale=p.compute_scale[:1]))[0])
     assert od < ld, "offloading should beat local compute in the spec regime"
     assert float(reward) == pytest.approx(ld - od, abs=1e-3)
     assert int(st2.task_success[0]) == 1
@@ -227,7 +230,7 @@ def test_avail_actions_modes():
 def test_obs_entity_structure():
     env = make_env()
     st = manual_state(env, [0, 1, 0, 1], [[(8000.0, 100.0)]] * 4)
-    raw = np.asarray(env._raw_obs(st))
+    raw = np.asarray(env._raw_obs(st, env.default_params()))
     assert raw.shape == (4, 4 * 9)
     rows = raw.reshape(4, 4, 9)
     # observer 0 (MEC0) sees agents 0,2 (same MEC); rows for 1,3 are zeros

@@ -429,7 +429,8 @@ def test_registry_names_and_structure():
     assert set(reg) == {"rollout", "insert", "train_iter", "superstep",
                         "dp_superstep", "learner_train", "serve_step",
                         "attn_xla", "attn_pallas",
-                        "actor_step", "learner_step"}
+                        "actor_step", "learner_step",
+                        "env_reset", "env_step"}
     # the donated hot programs are the compiled (memory-audited) ones
     assert reg["superstep"].compile and reg["train_iter"].compile
     assert reg["superstep"].donate_argnums == (0,)

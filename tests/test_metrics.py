@@ -40,6 +40,8 @@ class FakeStats:
     episode_limit: np.ndarray = None
     task_completion_rate: np.ndarray = None
     task_completion_delay: np.ndarray = None
+    deadline_miss_rate: np.ndarray = None
+    scenario: np.ndarray = None         # graftworld family tags (optional)
 
     def __post_init__(self):
         for k in TERMINAL_INFO_KEYS:
