@@ -21,8 +21,11 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shlex
+import socket
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -44,12 +47,26 @@ _REC = SpanRecorder(ring_size=128)
 #: never masquerade as the pinned platform's
 _RECORD_EXTRA: dict = {}
 
+#: BENCH record schema version — every record now carries uniform
+#: ``schema``/``platform``/``host`` meta (the r01–r07 series is
+#: heterogeneous; ``obs timeline`` tolerates every historical shape)
+BENCH_SCHEMA = 1
+_HOST = socket.gethostname()
+
 
 def _finalize(rec: dict) -> dict:
-    """Attach the per-phase span summary + any record-wide tags
+    """Attach the per-phase span summary, the uniform
+    ``schema``/``platform``/``host`` meta, + any record-wide tags
     (platform fallback) to a bench record before emission."""
     rec.setdefault("spans", _REC.summary())
     rec.update(_RECORD_EXTRA)
+    rec.setdefault("schema", BENCH_SCHEMA)
+    rec.setdefault("host", _HOST)
+    # platform: the live backend when main() recorded one
+    # (_RECORD_EXTRA), else the env pin. NEVER jax.default_backend()
+    # from here — on a probe-failure record that call would block on
+    # the very wedged backend this record exists to report
+    rec.setdefault("platform", os.environ.get("JAX_PLATFORMS") or None)
     return rec
 
 
@@ -592,7 +609,7 @@ def bench_kernels(make_cfg_kernels, _time, args) -> int:
               f"env-steps (dense acting, "
               f"{cfg.env_args.agv_num} AGVs, d{cfg.model.emb})",
               file=sys.stderr)
-        print(json.dumps({
+        print(json.dumps(_finalize({
             "metric": "env_steps_per_sec",
             "value": round(rate, 1),
             "unit": "env-steps/s/chip",
@@ -603,9 +620,7 @@ def bench_kernels(make_cfg_kernels, _time, args) -> int:
                        else args.config),
             "n_envs": cfg.batch_size_run,
             "episode_steps": cfg.env_args.episode_limit,
-            **_RECORD_EXTRA,
-        "spans": _REC.summary(),
-        }), flush=True)
+        })), flush=True)
     return rc
 
 
@@ -794,7 +809,7 @@ def bench_sebulba(cfg, _time, args) -> int:
           f"{sb.queue_slots}, staleness={sb.staleness}): "
           f"{dt_overlap * 1e3:.1f} ms -> {rate_overlap:,.0f} env-steps/s "
           f"({speedup:.2f}x serialized)", file=sys.stderr)
-    print(json.dumps({
+    print(json.dumps(_finalize({
         "metric": "env_steps_per_sec",
         "value": round(rate_overlap, 1),
         "unit": "env-steps/s/2-device-split",
@@ -819,9 +834,7 @@ def bench_sebulba(cfg, _time, args) -> int:
         "train_batch_episodes": bs,
         "chained_iters": k,
         "backend": jax.default_backend(),
-        **_RECORD_EXTRA,
-        "spans": _REC.summary(),
-    }))
+    })))
     return 0
 
 
@@ -870,7 +883,7 @@ def bench_superstep(cfg, _time, args) -> int:
     print(f"# superstep K={k}: {dt * 1e3:.1f} ms/dispatch for {env_steps} "
           f"env-steps + {k if gate_open else 0} train iters "
           f"({b} envs x {t_len} slots, train batch {bs})", file=sys.stderr)
-    print(json.dumps({
+    print(json.dumps(_finalize({
         "metric": "env_steps_per_sec",
         "value": round(rate, 1),
         "unit": "env-steps/s/chip",
@@ -883,9 +896,7 @@ def bench_superstep(cfg, _time, args) -> int:
         "train_batch_episodes": bs,
         "train_gate_open": gate_open,
         "dispatch_s": round(dt, 4),
-        **_RECORD_EXTRA,
-        "spans": _REC.summary(),
-    }))
+    })))
     return 0
 
 
@@ -980,14 +991,14 @@ def bench_hbm(cfg, args) -> int:
           f"(storage={'compact' if compact else 'dense'}, "
           f"remat={'on' if cfg.model.remat else 'off'}; excludes XLA "
           f"workspace/fragmentation)", file=sys.stderr)
-    print(json.dumps({
+    print(json.dumps(_finalize({
         "metric": "hbm_estimate_gib",
         "value": round(total / gib, 3),
         "unit": "GiB",
         "vs_baseline": None,
         "config": None if args.envs or args.steps else args.config,
         "breakdown_gib": {k: round(v / gib, 3) for k, v in rows.items()},
-    }))
+    })))
     return 0
 
 
@@ -1178,7 +1189,7 @@ def bench_serve(args) -> int:
     print(f"# serve throughput at bucket {bmax}: "
           f"{decisions:,.0f} decisions/s ({a} agents/request, "
           f"hidden carried)", file=sys.stderr)
-    print(json.dumps({
+    print(json.dumps(_finalize({
         "metric": "serve_decisions_per_sec",
         "value": round(decisions, 1),
         "unit": "decisions/s/chip",
@@ -1193,9 +1204,7 @@ def bench_serve(args) -> int:
         "backend": jax.default_backend(),
         "artifact": args.artifact,
         "checkpoint_t_env": fe.meta.get("checkpoint", {}).get("t_env"),
-        **_RECORD_EXTRA,
-        "spans": _REC.summary(),
-    }))
+    })))
     return 0
 
 
@@ -1309,6 +1318,245 @@ def bench_all(make_cfg, _time, _pipe_rate, args) -> int:
     except Exception as e:                  # pragma: no cover - defensive
         print(f"# breakdown failed: {e!r}", file=sys.stderr)
     return 0
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _daemon_legs(args) -> list:
+    """The daemon's A/B matrix: one (name, child argv) per leg —
+    exactly the legs ROADMAP open item 1 names (``--superstep``,
+    ``--kernels ab``, ``--sebulba``, plus ``--serve`` when an artifact
+    is given). Each leg runs in its own child so per-leg platform
+    constraints (sebulba's pre-import XLA_FLAGS, the kernel switch)
+    never collide in one process; ``--legs`` subsets the matrix."""
+    sm = ["--smoke"] if args.smoke else []
+    it = ["--iters", str(args.iters)]
+    legs = [
+        ("superstep", ["--superstep", "4", *sm, *it]),
+        ("kernels", ["--kernels", "ab", *sm, *it]),
+        ("sebulba", ["--sebulba", *sm, *it]),
+    ]
+    if args.artifact:
+        legs.append(("serve",
+                     ["--serve", "--artifact", args.artifact, *it]))
+    if args.legs:
+        want = [s.strip() for s in args.legs.split(",") if s.strip()]
+        if "serve" in want and not args.artifact:
+            raise SystemExit("--legs serve needs --artifact DIR")
+        unknown = set(want) - {n for n, _ in legs}
+        if unknown:
+            raise SystemExit(
+                f"--legs: unknown leg(s) {sorted(unknown)}; valid: "
+                f"superstep,kernels,sebulba"
+                + (",serve" if args.artifact else
+                   " (serve needs --artifact)"))
+        legs = [(n, a) for n, a in legs if n in want]
+    return legs
+
+
+def _daemon_run_leg(bench_path: str, name: str, argv: list,
+                    timeout_s: float, hub) -> tuple:
+    """One matrix leg as a child process: a 1 s wait loop publishes
+    ``daemon_leg_elapsed_seconds{leg=}`` while the child runs (legs
+    print their record only at completion, so stdout is NOT a liveness
+    signal — elapsed-vs-leg-timeout is the in-leg wedge signal, while
+    the daemon's own ticker thread keeps the beat age honest); stdout
+    is streamed for the records, stderr inherited (progress comments
+    stay live on the console), kill + reap at the timeout.
+    → (records, rc, note)."""
+    proc = subprocess.Popen([sys.executable, bench_path, *argv],
+                            stdout=subprocess.PIPE, text=True)
+    lines: list = []
+
+    def _reader():
+        for line in proc.stdout:
+            lines.append(line)
+
+    th = threading.Thread(target=_reader, daemon=True,
+                          name=f"bench-daemon-{name}")
+    th.start()
+    note = None
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+    try:
+        while True:
+            try:
+                proc.wait(timeout=1.0)
+                break
+            except subprocess.TimeoutExpired:
+                if hub is not None:
+                    hub.set("daemon_leg_elapsed_seconds",
+                            round(time.monotonic() - t0, 1), leg=name)
+                if time.monotonic() >= deadline:
+                    note = f"leg killed at its {timeout_s:.0f}s timeout"
+                    break
+    finally:
+        # kill AND reap unconditionally (the probe_backend discipline)
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+    th.join(timeout=5.0)
+    records = []
+    for line in lines:
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                records.append(json.loads(line))
+                continue
+            except ValueError:
+                pass
+        if line:
+            print(f"# [{name}] {line}", file=sys.stderr)
+    return records, proc.returncode, note
+
+
+def bench_daemon(args) -> int:
+    """``--daemon``: the surviving bench (ROADMAP open item 1). Every
+    TPU bench since BENCH_r02 died at axon backend init — one probe,
+    one death, no record. The daemon instead treats backend init as a
+    RETRYABLE phase on the watchdog backoff ladder: probe in a killable
+    child, back off (exp + jitter, ``T2OMCA_BENCH_DAEMON_BACKOFF``
+    base), and retry until the tunnel opens or the total budget
+    (``T2OMCA_BENCH_DAEMON_BUDGET``, default 4 h) runs out — then runs
+    the full A/B matrix (``--superstep 4``, ``--kernels ab``,
+    ``--sebulba``, ``--serve`` with ``--artifact``) in ONE session,
+    each leg a child process, relaying one complete BENCH record per
+    leg to stdout as it lands (a late wedge still leaves every earlier
+    leg's record). ``--pulse-port`` serves live heartbeats throughout:
+    ``/metrics`` carries probe attempts, budget remaining, the running
+    leg and its live elapsed seconds (legs print only at completion,
+    so elapsed-vs-timeout is the wedge signal, not stdout), so a
+    wedged tunnel is WATCHED instead of silent. ``T2OMCA_BENCH_DAEMON_PROBE_CMD``
+    overrides the probed command (tests inject wedges with it). The
+    daemon parent never imports jax — a wedged backend can only ever
+    cost a killable child."""
+    from t2omca_tpu.obs.pulse import MetricsHub, PulseServer
+    from t2omca_tpu.utils import watchdog as _wd
+
+    hub = server = None
+    if args.pulse_port is not None:
+        hub = MetricsHub()
+        try:
+            # trace_supported=False: the daemon parent is jax-free and
+            # has no TraceController — /trace must say so instead of
+            # acking an arm nothing will ever consume
+            server = PulseServer(hub, args.pulse_port, rec=_REC,
+                                 trace_supported=False).start()
+            print(f"# daemon: pulse heartbeats on :{server.port} "
+                  f"(/metrics, /healthz)", file=sys.stderr, flush=True)
+            hub.health("daemon", lambda: (True, "daemon running"))
+        except OSError as e:
+            print(f"# daemon: could not bind pulse port "
+                  f"{args.pulse_port} ({e}); heartbeats disabled",
+                  file=sys.stderr)
+            hub = None
+
+    budget = _env_float("T2OMCA_BENCH_DAEMON_BUDGET", 4 * 3600.0)
+    backoff = _env_float("T2OMCA_BENCH_DAEMON_BACKOFF", 30.0)
+    probe_each = _env_float("T2OMCA_BACKEND_PROBE_TIMEOUT", 900.0)
+    cmd_env = os.environ.get("T2OMCA_BENCH_DAEMON_PROBE_CMD")
+    probe_cmd = shlex.split(cmd_env) if cmd_env else None
+    deadline = time.monotonic() + budget
+
+    # the beat = "the daemon itself is alive": a dedicated 1 s ticker,
+    # because the orchestration thread BLOCKS inside probe_backend for
+    # up to probe_each (900 s) — beat age climbing through exactly the
+    # wedged-tunnel window would read as a hung daemon and get a
+    # healthy run killed by the very supervisor the endpoint serves
+    beat_stop = threading.Event()
+    if hub is not None:
+        def _ticker():
+            while not beat_stop.wait(1.0):
+                hub.beat()
+                hub.set("daemon_budget_remaining_seconds",
+                        max(deadline - time.monotonic(), 0.0))
+        threading.Thread(target=_ticker, daemon=True,
+                         name="bench-daemon-beat").start()
+
+    def _done(rc: int) -> int:
+        beat_stop.set()
+        if server is not None:
+            server.close()
+        return rc
+
+    # ---- phase 1: wait out the wedged tunnel --------------------------
+    attempt, failure = 0, None
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            # `attempt` counts probes actually LAUNCHED — the budget
+            # check precedes the increment so the record's diagnostic
+            # attempt count is never inflated by a never-probed pass
+            failure = failure or {"error": "daemon budget exhausted",
+                                  "phase": "timeout"}
+            break
+        attempt += 1
+        if hub is not None:
+            hub.set("daemon_probe_attempts", attempt)
+        with _REC.span("bench.daemon.probe", attempt=attempt):
+            failure = probe_backend(min(probe_each, remaining),
+                                    _cmd=probe_cmd, attempts=1)
+        if failure is None:
+            break
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        delay = min(_wd.backoff_delay(attempt, backoff, max_s=600.0),
+                    max(remaining, 0.0))
+        print(f"# daemon: probe attempt {attempt} failed "
+              f"({failure['error'][:120]}); backoff ladder retries in "
+              f"{delay:.1f}s ({remaining:.0f}s of budget left)",
+              file=sys.stderr, flush=True)
+        time.sleep(delay)
+    if failure is not None:
+        # the budget ran out with the tunnel still wedged: one partial
+        # record saying so (the r03+ class, now with the attempt count)
+        print(json.dumps(_finalize({
+            "metric": "bench_daemon_legs", "value": None, "unit": "legs",
+            "vs_baseline": None, "probe_attempts": attempt, **failure,
+        }), default=repr), flush=True)
+        return _done(1)
+    print(f"# daemon: backend probe succeeded on attempt {attempt}; "
+          f"running the A/B matrix", file=sys.stderr, flush=True)
+
+    # ---- phase 2: the full A/B matrix, one child per leg --------------
+    legs = _daemon_legs(args)
+    leg_timeout = _env_float("T2OMCA_BENCH_DAEMON_LEG_TIMEOUT", 3600.0)
+    bench_path = os.path.abspath(__file__)
+    results: dict = {}
+    measured = 0
+    for i, (name, argv) in enumerate(legs):
+        if hub is not None:
+            hub.beat()
+            hub.set("daemon_leg_running", 1, leg=name)
+        with _REC.span("bench.daemon.leg", leg=name):
+            records, rc, note = _daemon_run_leg(bench_path, name, argv,
+                                                leg_timeout, hub)
+        for r in records:
+            r.setdefault("leg", name)
+            print(json.dumps(_finalize(r), default=repr), flush=True)
+        ok = any(isinstance(r.get("value"), (int, float))
+                 for r in records)
+        measured += bool(ok)
+        results[name] = {"rc": rc, "records": len(records),
+                         "measured": ok}
+        if note:
+            results[name]["note"] = note
+        if hub is not None:
+            hub.set("daemon_leg_running", 0, leg=name)
+            hub.set("daemon_legs_completed", i + 1)
+    print(json.dumps(_finalize({
+        "metric": "bench_daemon_legs", "value": measured,
+        "unit": "legs-measured", "vs_baseline": None,
+        "matrix": [n for n, _ in legs], "legs": results,
+        "probe_attempts": attempt,
+    }), default=repr), flush=True)
+    return _done(0 if measured == len(legs) else 1)
 
 
 #: BASELINE.json measurement scale points (see BASELINE.md §configs):
@@ -1428,6 +1676,22 @@ def main() -> int:
                          "K=1 still fuses the three stages into one "
                          "program). Reports the dispatch-amortized "
                          "env-steps/s including training")
+    ap.add_argument("--daemon", action="store_true",
+                    help="the surviving bench (ROADMAP item 1): retry "
+                         "backend init on the backoff ladder until the "
+                         "wedged tunnel opens (T2OMCA_BENCH_DAEMON_"
+                         "BUDGET total, default 4h), then run the full "
+                         "A/B matrix (--superstep 4, --kernels ab, "
+                         "--sebulba, --serve with --artifact) as child "
+                         "processes in ONE session, one BENCH record "
+                         "per leg; --pulse-port serves live heartbeats")
+    ap.add_argument("--legs", default=None, metavar="a,b,...",
+                    help="--daemon: subset of the matrix to run "
+                         "(superstep,kernels,sebulba,serve)")
+    ap.add_argument("--pulse-port", type=int, default=None, metavar="P",
+                    help="--daemon: serve /metrics + /healthz "
+                         "heartbeats on this port (0 = ephemeral, "
+                         "printed to stderr)")
     ap.add_argument("--pipeline", type=int, default=None, metavar="K",
                     help="also report the steady-state rate over K "
                          "async-chained rollouts with one terminal sync "
@@ -1436,6 +1700,27 @@ def main() -> int:
                          "defaults to K=4 on full-scale runs, pass 0 "
                          "to disable")
     args = ap.parse_args()
+    if args.daemon:
+        if (args.all or args.hbm or args.prod_hbm or args.breakdown
+                or args.train or args.serve or args.superstep is not None
+                or args.kernels is not None or args.sebulba):
+            ap.error("--daemon runs the full A/B matrix itself "
+                     "(--superstep 4, --kernels ab, --sebulba, --serve "
+                     "when --artifact is given); drop the per-leg flags")
+        if args.pipeline:
+            ap.error("--daemon legs own their pipelining; drop "
+                     "--pipeline")
+    else:
+        if args.pulse_port is not None:
+            ap.error("--pulse-port is the daemon's heartbeat endpoint; "
+                     "add --daemon (training runs use the config key "
+                     "obs.pulse_port instead)")
+        if args.legs is not None:
+            ap.error("--legs only applies to --daemon")
+    if args.daemon:
+        # the daemon parent must never import jax: a wedged backend may
+        # only ever cost a killable child process
+        return bench_daemon(args)
     if args.serve:
         if args.artifact is None:
             ap.error("--serve needs --artifact DIR (an exported serving "
@@ -1542,16 +1827,15 @@ def main() -> int:
                             and os.environ.get("T2OMCA_BENCH_FALLBACK")
                             == "1")
             if not use_fallback:
-                print(json.dumps({
+                print(json.dumps(_finalize({
                     "metric": metric, "value": None,
                     "unit": unit, "vs_baseline": None, **failure,
-                    "spans": _REC.summary(),
                     # the flight tail rides along like main_flight's
                     # partial record: a wedged-tunnel probe failure then
                     # shows its phase history (BENCH_r03–r05 left only a
                     # bare error)
                     "spans_tail": _REC.tail()[-20:],
-                }, default=repr), flush=True)
+                }), default=repr), flush=True)
                 return 1
             # explicit opt-in (T2OMCA_BENCH_FALLBACK=1): continue on the
             # auto-selected backend — jax is already imported but no
@@ -1567,6 +1851,11 @@ def main() -> int:
             jax.config.update("jax_platforms", None)
             _RECORD_EXTRA["platform"] = failure["fallback"]["backend"]
             _RECORD_EXTRA["probe_failure"] = failure["error"][:200]
+
+    # backend committed (probe passed, fallback chosen, or smoke/hbm CPU
+    # pin): record the LIVE platform for the uniform record meta — safe
+    # to initialize here, the first bench leg would have anyway
+    _RECORD_EXTRA.setdefault("platform", jax.default_backend())
 
     if args.serve:
         # the serving leg needs no train config at all — everything
@@ -1866,16 +2155,15 @@ def main_flight() -> int:
                         else ("env_steps_per_sec", "env-steps/s/chip"))
         print(f"# bench failed in phase {phase or 'unknown'}: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
-        print(json.dumps({
+        print(json.dumps(_finalize({
             "metric": metric, "value": None,
             "unit": unit, "vs_baseline": None,
             "error": f"{type(e).__name__}: {e}"[:500],
             "phase": phase,
-            "spans": _REC.summary(),
             "spans_tail": _REC.tail()[-20:],
             # default=repr: a non-JSON span-meta value must degrade,
             # not crash the crash handler and lose the record
-        }, default=repr), flush=True)
+        }), default=repr), flush=True)
         return 1
 
 

@@ -18,6 +18,13 @@ set -o pipefail
 cd "$(dirname "$0")/.." || exit 2
 bash scripts/lint.sh 2>&1 | tee /tmp/_t1_lint.log; lrc=${PIPESTATUS[0]}
 [ $lrc -ne 0 ] && { [ $lrc -eq 1 ] && echo "graftlint gate failed (new findings above; docs/ANALYSIS.md)" || echo "graftlint internal error (exit $lrc; docs/ANALYSIS.md)"; exit 1; }
+# Prelude 1b (obs timeline, ~1 s, jax-free): the longitudinal BENCH
+# trajectory CLI over the checked-in records must exit 0 and render the
+# r03+ wedged partials as wedged rows — the post-mortem tool must not
+# rot while the TPU tunnel is down.
+timeout -k 5 60 python -m t2omca_tpu.obs timeline BENCH_r*.json 2>&1 | tee /tmp/_t1_timeline.log; tlc=${PIPESTATUS[0]}
+[ $tlc -ne 0 ] && { echo "obs timeline smoke failed (exit $tlc; docs/OBSERVABILITY.md §pulse)"; exit 1; }
+grep -q "wedged" /tmp/_t1_timeline.log || { echo "obs timeline smoke: wedged BENCH rows missing from the table (docs/OBSERVABILITY.md §pulse)"; exit 1; }
 # JAX_PLATFORMS pinned HERE, not just inside the CLI: the CLI's own pin
 # is a setdefault, and a preset JAX_PLATFORMS=tpu would otherwise make
 # the audit hit the platform-mismatch branch (warn + exit 0) — a silent

@@ -283,6 +283,28 @@ class ObsConfig:
     # JSONL sink is the durable record. print_recent_stats only reads
     # the last 5 entries, so any cap >= 5 is observationally identical.
     stats_history: int = 1024
+    # ---- graftpulse live telemetry plane (obs/pulse.py) ----------------
+    # TCP port for the stdlib-only HTTP metrics endpoint (Prometheus-text
+    # /metrics + JSON /healthz + /trace trigger). 0 (default) = no
+    # server, no socket, driver byte-identical to a build without the
+    # plane. Independent of `enabled`: the gauges need no span recorder
+    # (span decoration of the scrape path simply degrades to no-ops when
+    # telemetry is off).
+    pulse_port: int = 0
+    # bind address for the endpoint. Loopback by default: /trace is an
+    # unauthenticated state-changing route (arms live profiler
+    # captures), so reaching it from off-host is an explicit "0.0.0.0"
+    # opt-in, never a default.
+    pulse_host: str = "127.0.0.1"
+    # sliding-sample window for the pulse quantile gauges (serve p50/p99
+    # etc.) — bounds the hub's memory, not a statistics knob
+    pulse_window: int = 512
+    # HBM memwatch (obs/memwatch.py): per-device memory snapshots at
+    # phase boundaries with phase-attributed high-water tracking, merged
+    # into flight_recorder.json / stall_diagnosis.json. Requires
+    # `enabled` (the snapshots ride the span/flight machinery — same
+    # dead-knob policy as program_trace).
+    memwatch: bool = False
 
 
 @dataclass(frozen=True)
@@ -597,6 +619,19 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
             "contradictory (same dead-knob policy as "
             "first_dispatch_timeout without dispatch_timeout); set "
             "obs.enabled=true too")
+    if not 0 <= o.pulse_port <= 65535:
+        raise ValueError(f"obs.pulse_port must be in 0..65535 (0 = no "
+                         f"metrics endpoint), got {o.pulse_port}")
+    if o.pulse_window < 16:
+        raise ValueError(f"obs.pulse_window must be >= 16 (quantiles "
+                         f"over fewer samples are noise), got "
+                         f"{o.pulse_window}")
+    if o.memwatch and not o.enabled:
+        raise ValueError(
+            "obs.memwatch merges its snapshots into the span/flight "
+            "artifacts — with obs.enabled=false none of those exist and "
+            "the key is silently dead (same policy as program_trace); "
+            "set obs.enabled=true too")
     sb = cfg.sebulba
     if (sb.actor_devices > 0) != (sb.learner_devices > 0):
         raise ValueError(

@@ -15,6 +15,13 @@ Four pieces (docs/OBSERVABILITY.md):
   joins the runtime telemetry against graftprog's FLOPs/bytes budgets
   into a roofline-style per-program breakdown.
 
+Plus the **graftpulse live plane** (docs/OBSERVABILITY.md §pulse):
+``pulse.py`` (Prometheus-text ``/metrics`` + ``/healthz`` + on-demand
+``/trace`` behind ``obs.pulse_port``), ``memwatch.py`` (phase-
+attributed HBM high-water snapshots merged into the flight/stall
+artifacts), and ``timeline.py`` (the jax-free
+``python -m t2omca_tpu.obs timeline`` longitudinal BENCH trajectory).
+
 The span/report half is stdlib-only; ``device_time`` pulls in jax, so
 its names resolve lazily — importing ``t2omca_tpu.obs`` must stay
 cheap enough for the jax-free report CLI.
@@ -30,6 +37,15 @@ _LAZY = {
     "parse_trace_device_times": "device_time",
     "PHASE_PROGRAMS": "report",
     "report_main": "report",
+    # graftpulse live telemetry plane (stdlib-only modules; lazy so the
+    # jax-free CLIs pay nothing for what they don't use)
+    "MetricsHub": "pulse",
+    "PulseServer": "pulse",
+    "TraceController": "pulse",
+    "make_pulse": "pulse",
+    "MemWatch": "memwatch",
+    "make_memwatch": "memwatch",
+    "timeline_main": "timeline",
 }
 
 __all__ = ["KNOWN_PHASES", "NULL_RECORDER", "NullRecorder",

@@ -1,4 +1,4 @@
-"""``python -m t2omca_tpu.obs`` — the graftscope CLI.
+"""``python -m t2omca_tpu.obs`` — the graftscope/graftpulse CLI.
 
 Subcommands:
 
@@ -7,8 +7,19 @@ Subcommands:
     device-time attribution (``device_times.json``) against graftprog's
     FLOPs/bytes budgets (``analysis/programs.json``) into the per-
     program roofline table (docs/OBSERVABILITY.md). Exit 0 = report
-    printed, 2 = usage error. Deliberately jax-free — the post-mortem
-    host may not be able to initialize a backend at all.
+    printed, 2 = usage error. Degraded inputs render instead of
+    raising: a torn final JSONL line (killed run) is skipped with a
+    warning, and a run dir holding only a ``flight_recorder.json``
+    reports from the flight tail.
+
+``timeline [BENCH_r*.json ...] [--runs <run_dir> ...]``
+    The longitudinal perf-trajectory table over the repo's BENCH_r*
+    records (all historical shapes) and recorded runs' metrics.jsonl,
+    distinguishing measured numbers from wedged partials
+    (docs/OBSERVABILITY.md §pulse).
+
+Both are deliberately jax-free — the post-mortem host may not be able
+to initialize a backend at all.
 """
 
 from __future__ import annotations
@@ -20,7 +31,7 @@ import sys
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m t2omca_tpu.obs",
-        description="graftscope: run telemetry tools "
+        description="graftscope/graftpulse: run telemetry tools "
                     "(docs/OBSERVABILITY.md)")
     sub = parser.add_subparsers(dest="cmd", required=True)
     rep = sub.add_parser(
@@ -37,11 +48,25 @@ def main(argv=None) -> int:
     rep.add_argument("--peak-gbps", type=float, default=None,
                      help="chip peak memory bandwidth in GB/s (used "
                           "with --peak-gflops)")
+    tl = sub.add_parser(
+        "timeline", help="longitudinal perf-trajectory table over "
+                         "BENCH_r*.json records and run dirs")
+    tl.add_argument("paths", nargs="*",
+                    help="BENCH record files (default: BENCH_r*.json "
+                         "in the current directory)")
+    tl.add_argument("--runs", nargs="*", default=[], metavar="RUN_DIR",
+                    help="recorded run directories whose metrics.jsonl "
+                         "joins the table (newest env-steps/s)")
+    tl.add_argument("--json", action="store_true",
+                    help="machine-readable rows instead of the table")
     args = parser.parse_args(argv)
     if args.cmd == "report":
         from .report import report_main
         return report_main(args.run_dir, args.programs_json,
                            args.peak_gflops, args.peak_gbps)
+    if args.cmd == "timeline":
+        from .timeline import timeline_main
+        return timeline_main(args.paths, args.runs, as_json=args.json)
     parser.error(f"unknown command {args.cmd!r}")
     return 2
 

@@ -34,7 +34,10 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 from typing import Dict, List, Optional
+
+from ..utils.ioutil import read_jsonl_tolerant
 
 #: span phase -> graftprog program name (analysis/programs.json key).
 #: ``dispatch.test`` dispatches the same compiled rollout program as
@@ -72,19 +75,40 @@ SLICE_METRICS = (("return", "return_mean"),
                  ("dl-miss", "deadline_miss_rate_mean"))
 
 
+def _warn_torn(path: str):
+    """on_bad hook for the tolerant JSONL readers: a torn FINAL line is
+    the expected artifact of a killed run (crash / SIGKILL / hard
+    watchdog exit mid-write) — skipped with a warning, never raised on;
+    a torn mid-file line is flagged as the oddity it is."""
+    def _on_bad(line_no: int, is_last: bool) -> None:
+        what = ("torn final line — the artifact a killed run leaves"
+                if is_last else "unparseable mid-file line")
+        print(f"graftscope: warning: {path}:{line_no}: {what}; skipped",
+              file=sys.stderr)
+    return _on_bad
+
+
 def load_events(run_dir: str) -> List[dict]:
     path = os.path.join(run_dir, "spans.jsonl")
-    events: List[dict] = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                events.append(json.loads(line))
-            except ValueError:
-                continue            # torn final line (crash mid-write)
-    return events
+    events = read_jsonl_tolerant(path, on_bad=_warn_torn(path))
+    return [e for e in events if isinstance(e, dict)]
+
+
+def load_flight_events(run_dir: str) -> Optional[List[dict]]:
+    """Degraded-input fallback: a run that died before (or without)
+    flushing ``spans.jsonl`` may still have persisted its flight ring
+    (``flight_recorder.json``, same event schema, bounded tail). None
+    when absent/unreadable."""
+    path = os.path.join(run_dir, "flight_recorder.json")
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    events = payload.get("events")
+    if not isinstance(events, list):
+        return None
+    return [e for e in events if isinstance(e, dict)]
 
 
 def load_device_times(run_dir: str) -> Dict[str, dict]:
@@ -106,15 +130,11 @@ def scenario_slices(run_dir: str) -> Dict[str, Dict[int, dict]]:
     path = os.path.join(run_dir, "metrics.jsonl")
     out: Dict[str, Dict[int, dict]] = {}
     try:
-        f = open(path)
+        events = read_jsonl_tolerant(path, on_bad=_warn_torn(path))
     except OSError:
         return out
-    with f:
-        for line in f:
-            try:
-                ev = json.loads(line)
-            except ValueError:
-                continue            # torn final line
+    for ev in events:
+        if isinstance(ev, dict):
             key = ev.get("key", "")
             prefix = ""
             if key.startswith("test_"):
@@ -402,7 +422,25 @@ def render(run_dir: str, events: List[dict], rows: List[dict],
                      "starvation); params.sync mixes the learner "
                      "publish with the actor's staleness wait and is "
                      "counted on neither side")
-    lines.extend(render_slices(scenario_slices(run_dir)))
+    slices = scenario_slices(run_dir)
+    if any(slices.values()):
+        lines.extend(render_slices(slices))
+    else:
+        # degraded input honesty: a metrics.jsonl that exists but holds
+        # no slice rows (empty file, or a run killed before the first
+        # cadence) renders a stated "no data" instead of silently
+        # omitting the section a graftworld run's reader expects
+        mpath = os.path.join(run_dir, "metrics.jsonl")
+        try:
+            empty = os.path.getsize(mpath) == 0
+        except OSError:
+            empty = False               # no metrics.jsonl at all: a
+            # single-scenario run — the section stays absent, as before
+        if empty:
+            lines.append("")
+            lines.append("scenario slices: no data (metrics.jsonl is "
+                         "empty — run killed before the first log "
+                         "cadence?)")
     return "\n".join(lines)
 
 
@@ -451,8 +489,6 @@ def report_main(run_dir: str, programs_json: Optional[str] = None,
     """The ``report`` subcommand body. Exit codes match the analysis
     CLI convention: 0 = report printed, 2 = usage error (missing run
     dir / unreadable telemetry)."""
-    import sys
-
     if not os.path.isdir(run_dir):
         print(f"graftscope: error: {run_dir!r} is not a directory",
               file=sys.stderr)
@@ -460,9 +496,18 @@ def report_main(run_dir: str, programs_json: Optional[str] = None,
     try:
         events = load_events(run_dir)
     except OSError as e:
-        print(f"graftscope: error: no spans.jsonl in {run_dir!r} ({e}); "
-              f"record the run with obs.enabled=true", file=sys.stderr)
-        return 2
+        # degraded-input fallback: a run dir holding only the persisted
+        # flight ring (crash before any spans flush) still reports from
+        # that bounded tail — stated, so nobody mistakes it for the run
+        events = load_flight_events(run_dir)
+        if events is None:
+            print(f"graftscope: error: no spans.jsonl in {run_dir!r} "
+                  f"({e}) and no flight_recorder.json fallback; record "
+                  f"the run with obs.enabled=true", file=sys.stderr)
+            return 2
+        print(f"graftscope: note: no spans.jsonl — reporting from the "
+              f"flight-recorder tail ({len(events)} events; bounded "
+              f"ring, not the full run)", file=sys.stderr)
     from ..analysis.baseline import DEFAULT_PROGRAMS, load_programs
     try:
         base = load_programs(programs_json or DEFAULT_PROGRAMS)

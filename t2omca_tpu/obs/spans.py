@@ -100,6 +100,13 @@ KNOWN_PHASES = frozenset({
     # serving run's spans.jsonl exactly like a training run's
     "serve.export", "serve.load", "serve.pad", "serve.dispatch",
     "serve.unpad",
+    # graftpulse live telemetry plane (obs/pulse.py, obs/memwatch.py):
+    # one /metrics-endpoint scrape, one per-device HBM snapshot, the
+    # PULSE_TRACE-file / /trace-endpoint arming of a live trace window,
+    # and the bench daemon's two orchestration boundaries (the backoff-
+    # laddered backend-init probe and one A/B matrix leg subprocess)
+    "pulse.scrape", "memwatch.snapshot", "trace.trigger",
+    "bench.daemon.probe", "bench.daemon.leg",
 })
 
 _NOOP = contextlib.nullcontext()
@@ -185,11 +192,21 @@ class SpanRecorder:
 
     # -- recording -------------------------------------------------------
 
-    def span(self, phase: str, t_env: int = 0, **meta) -> _Span:
+    def span(self, phase: str, t_env: int = 0, _ring: bool = True,
+             **meta) -> _Span:
         """Context manager recording one span. ``meta`` must be
-        JSON-serializable scalars (attempt counts, K, ...)."""
+        JSON-serializable scalars (attempt counts, K, ...).
+        ``_ring=False`` keeps the completed span OUT of the flight ring
+        (it still lands in the JSONL sink and the per-phase aggregate):
+        for high-frequency decorative spans — the pulse endpoint's
+        per-scrape spans — which would otherwise evict the pre-stall
+        phase history the ring exists to preserve (a 5 s scrape cadence
+        fills a 256-slot ring in ~21 min, shorter than one
+        compile-scale hang)."""
         ev: Dict[str, Any] = {"event": "span", "phase": phase,
                               "t_env": int(t_env)}
+        if not _ring:
+            ev["_ring"] = False
         if meta:
             ev.update(meta)
         return _Span(self, ev)
@@ -234,7 +251,8 @@ class SpanRecorder:
                 # an exception is not a completion)
                 a["first_ms"] = wall_ms
                 ev["first"] = True
-            self._ring.append(ev)
+            if ev.pop("_ring", True):
+                self._ring.append(ev)
             self._sink(ev)
 
     def mark(self, kind: str, **meta) -> None:
@@ -298,6 +316,7 @@ class SpanRecorder:
             out = [dict(ev) for ev in self._ring]
             for seq in sorted(self._open):
                 ev = dict(self._open[seq])
+                ev.pop("_ring", None)   # internal flag, not schema
                 ev["open"] = True
                 ev["wall_ms"] = round(
                     (now - self._open_pc[seq]) * 1000.0, 3)
@@ -312,15 +331,21 @@ class SpanRecorder:
                 return None
             return self._open[max(self._open)]["phase"]
 
-    def persist(self, path: str) -> Optional[str]:
+    def persist(self, path: str,
+                extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
         """Atomically write the flight tail as JSON (tmp + rename).
-        Best-effort; returns the path or None."""
+        Best-effort; returns the path or None. ``extra`` is merged into
+        the payload next to the events — the driver passes the HBM
+        memwatch report (obs/memwatch.py) so an OOM/wedge flight dump
+        says what held device memory."""
         try:
             # default=repr lives in the helper, same reason as _sink:
             # the flight dump runs on crash/stall paths where raising
             # is worst-case
-            return write_json_atomic(path,
-                                     {"version": 1, "events": self.tail()})
+            payload: Dict[str, Any] = {"version": 1, "events": self.tail()}
+            if extra:
+                payload.update(extra)
+            return write_json_atomic(path, payload)
         except (OSError, TypeError, ValueError):
             return None
 
@@ -367,7 +392,7 @@ class NullRecorder:
     def current_phase(self) -> Optional[str]:
         return None
 
-    def persist(self, path: str) -> Optional[str]:
+    def persist(self, path: str, extra=None) -> Optional[str]:
         return None
 
     def summary(self) -> Dict[str, Dict[str, float]]:

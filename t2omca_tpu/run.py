@@ -37,6 +37,8 @@ from .learners.qmix_learner import LEARNER_REGISTRY, LearnerState
 from .runners import RUNNER_REGISTRY
 from .runners.episode_runner import EpisodeRunner
 from .runners.parallel_runner import ParallelRunner, RunnerState
+from .obs import memwatch as obs_memwatch
+from .obs import pulse as obs_pulse
 from .obs import spans as obs_spans
 from .utils import resilience, watchdog
 from .utils.checkpoint import (find_checkpoint, load_checkpoint,
@@ -468,6 +470,24 @@ def run_sequential(exp: Experiment, logger: Logger,
     env_info = exp.env.get_env_info()
     log.info(f"env_info: {env_info}")
 
+    # ---- graftpulse live telemetry plane (docs/OBSERVABILITY.md §pulse)
+    # obs.pulse_port unset (default) leaves all three as no-op/None —
+    # the loop below is byte-identical to a build without the plane
+    pulse = obs_pulse.make_pulse(cfg.obs, rec=rec, log=log)
+    mw = obs_memwatch.make_memwatch(cfg.obs, rec=rec)
+    mw.snapshot("startup", t_env=0)
+    trc = (obs_pulse.TraceController(
+               results_dir, rec=rec,
+               hub=pulse.hub if pulse is not None else None,
+               n_iterations=cfg.profile_iterations)
+           if (rec.enabled or pulse is not None) else None)
+
+    def _persist_flight(path: str) -> None:
+        """Flight persist + the memwatch high-water block (cached state
+        only — safe on crash/stall paths over a wedged backend)."""
+        rec.persist(path, extra=({"memwatch": mw.report()}
+                                 if mw.enabled else None))
+
     # ---- data parallelism (SURVEY.md §7.2(6)) --------------------------
     # dp_devices > 0 swaps in the mesh-sharded program triple; the loop
     # below is identical either way (same pure functions, GSPMD shardings
@@ -586,13 +606,17 @@ def run_sequential(exp: Experiment, logger: Logger,
         # a telemetry failure here must not abort the callback before
         # the diagnosis write and the guard trip below — the stall
         # response outranks its own decoration
-        extra = None
+        extra = {}
         if rec.enabled:
             try:
-                extra = {"recent_spans": rec.tail()}
+                extra["recent_spans"] = rec.tail()
             except Exception:  # noqa: BLE001 — diagnostics only
                 log.exception("graftscope: flight tail unavailable")
-        watchdog.write_diagnosis(diag, model_dir, extra=extra)
+        if mw.enabled:
+            # cached high-water only (report(), never snapshot()): the
+            # stall path must not read the wedged backend it diagnoses
+            extra["memwatch"] = mw.report()
+        watchdog.write_diagnosis(diag, model_dir, extra=extra or None)
         # trip the guard BEFORE the save attempt: the emergency save
         # below reads device state over the possibly-wedged backend and
         # can block without raising — with stall_grace_s=0 (no hard
@@ -646,6 +670,16 @@ def run_sequential(exp: Experiment, logger: Logger,
                  f"{res.stall_grace_s}s (exit {res.stall_exit_code})")
     ladder = watchdog.DegradationLadder(res.max_restores)
     dispatch_faults = 0             # transient dispatch errors seen (stats)
+    if pulse is not None:
+        # live health/heartbeat surface: the watchdog rows are read per
+        # scrape (visible while the main thread is wedged), and
+        # /healthz flips to degraded the moment a stall fires or the
+        # shutdown guard trips
+        if wd is not None:
+            pulse.wire_watchdog(wd)
+        pulse.wire_guard(guard)
+        pulse.set("superstep_k", K)
+        pulse.set("backend_info", 1, backend=jax.default_backend())
 
     def _watched(phase, state=None, **meta):
         """One watchdog stamp + graftscope span for a device-facing
@@ -866,7 +900,7 @@ def run_sequential(exp: Experiment, logger: Logger,
             # no checkpoint to stand on: fall through to abort
         # abort rung: persist the flight tail next to the checkpoints
         # (the stall-diagnosis merge covers hangs; this covers failures)
-        rec.persist(os.path.join(model_dir, "flight_recorder.json"))
+        _persist_flight(os.path.join(model_dir, "flight_recorder.json"))
         # consume the stall diagnosis only on abort: a degrade/restore
         # rung leaves it for the guard-triggered exit log (the causal
         # "stalled call eventually returned" chain) or a later abort
@@ -918,6 +952,12 @@ def run_sequential(exp: Experiment, logger: Logger,
             resilience.fire("driver.iteration", t_env=t_env, guard=guard)
             if guard.triggered:
                 break
+            if pulse is not None:
+                pulse.tick_iteration(t_env, episode)
+            if trc is not None:
+                # on-demand trace trigger (PULSE_TRACE file / /trace
+                # endpoint): one os.path.exists when idle
+                trc.poll(t_env)
             tracer.maybe_start(t_env)
             if K > 1:
                 # ------------ fused superstep (one dispatch = K iters) ------
@@ -1051,6 +1091,8 @@ def run_sequential(exp: Experiment, logger: Logger,
                     _dispatch_ladder(df, can_degrade=False)
                     continue
             tracer.tick(logger, t_env)
+            if trc is not None:
+                trc.tick(logger, t_env)
 
             # train-stat cadence: runner_log_interval, epsilon alongside
             # (reference parallel_runner.py:215-219). Deliberately after the
@@ -1175,6 +1217,9 @@ def run_sequential(exp: Experiment, logger: Logger,
                     if res.keep_last:
                         prune_checkpoints(model_dir, res.keep_last,
                                           res.keep_every)
+                    # checkpoint gather is a transient-HBM event worth
+                    # a memwatch boundary of its own (no-op when off)
+                    mw.snapshot("checkpoint.save", t_env=t_env)
                     # advance the cadence only on a real save: a
                     # lock-skipped attempt (None) retries next iteration
                     # instead of silently widening the data-loss window
@@ -1220,8 +1265,8 @@ def run_sequential(exp: Experiment, logger: Logger,
                         rec.mark("nonfinite", t_env=t_env,
                                  streak=nonfinite_streak,
                                  total=nonfinite_total)
-                        rec.persist(os.path.join(results_dir,
-                                                 "flight_recorder.json"))
+                        _persist_flight(os.path.join(
+                            results_dir, "flight_recorder.json"))
                         log.warning(
                             f"non-finite loss/grads in "
                             f"{int((~flags).sum())}/{len(flags)} train steps "
@@ -1279,11 +1324,23 @@ def run_sequential(exp: Experiment, logger: Logger,
                 # profile_stages is on
                 now = time.time()
                 if last_log_time is not None:
-                    logger.log_stat(
-                        "env_steps_per_sec",
-                        (t_env - last_log_t) / max(now - last_log_time, 1e-9),
-                        t_env)
+                    rate = ((t_env - last_log_t)
+                            / max(now - last_log_time, 1e-9))
+                    logger.log_stat("env_steps_per_sec", rate, t_env)
+                    if pulse is not None:
+                        pulse.set("env_steps_per_sec", rate)
                 last_log_time = now
+                # memwatch phase boundary + the live-plane cadence
+                # gauges (both no-ops when the plane is off)
+                pulse_snap = mw.snapshot("log", t_env=t_env)
+                if pulse is not None:
+                    pulse.set("nonfinite_streak", nonfinite_streak)
+                    pulse.set("nonfinite_total", nonfinite_total)
+                    pulse.set("dispatch_faults", dispatch_faults)
+                    pulse.set("ladder_failures", ladder.failures)
+                    pulse.set("restores", restores)
+                    pulse.set("superstep_k", K)
+                    pulse.set_memwatch(pulse_snap)
                 timer.log_and_reset(logger, t_env)
                 logger.print_recent_stats()
                 last_log_t = t_env
@@ -1294,7 +1351,7 @@ def run_sequential(exp: Experiment, logger: Logger,
         # (best-effort no-ops when telemetry is off; never masks ``e``)
         rec.mark("crash", t_env=t_env,
                  error=f"{type(e).__name__}: {e}"[:300])
-        rec.persist(os.path.join(results_dir, "flight_recorder.json"))
+        _persist_flight(os.path.join(results_dir, "flight_recorder.json"))
         rec.close()                     # flush the spans.jsonl tail too
         raise
     finally:
@@ -1304,6 +1361,8 @@ def run_sequential(exp: Experiment, logger: Logger,
         if wd is not None:
             wd.stop()
         guard.uninstall()
+        if pulse is not None:
+            pulse.close()               # bounded; never hangs the exit
 
     if guard.triggered:
         # ---- preemption path: lose at most one iteration ---------------
@@ -1311,7 +1370,7 @@ def run_sequential(exp: Experiment, logger: Logger,
         # the preempted run's last phases survive even if the emergency
         # checkpoint below cannot be written
         rec.mark("shutdown", t_env=t_env, signame=guard.signame or "")
-        rec.persist(os.path.join(results_dir, "flight_recorder.json"))
+        _persist_flight(os.path.join(results_dir, "flight_recorder.json"))
         stall = wd.take_diagnosis() if wd is not None else None
         if stall is not None:
             log.warning(f"watchdog: {stall.message()} — the stalled call "
@@ -1425,6 +1484,25 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
     log = logger.console_logger
     if rec is None:
         rec = obs_spans.make_recorder(cfg.obs, results_dir)
+    # graftpulse plane (same off-state contract as the classic loop);
+    # the decoupled layout is the one Podracer says lives or dies on
+    # utilization you can see live — queue depth, staleness, idle time
+    pulse = obs_pulse.make_pulse(cfg.obs, rec=rec, log=log)
+    mw = obs_memwatch.make_memwatch(cfg.obs, rec=rec)
+    mw.snapshot("startup", t_env=0)
+    # on-demand trace trigger, driven from the learner (main) thread —
+    # the profiler window captures whole-process device activity, so
+    # one driver is enough and the /trace route works on decoupled
+    # runs exactly like classic ones
+    trc = (obs_pulse.TraceController(
+               results_dir, rec=rec,
+               hub=pulse.hub if pulse is not None else None,
+               n_iterations=cfg.profile_iterations)
+           if (rec.enabled or pulse is not None) else None)
+
+    def _persist_flight(path: str) -> None:
+        rec.persist(path, extra=({"memwatch": mw.report()}
+                                 if mw.enabled else None))
     from .parallel.sebulba import make_sebulba
     seb = make_sebulba(exp)
     lockstep = sb.queue_slots == 1 and sb.staleness == 0
@@ -1493,13 +1571,15 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
         """Learner-side stall response (same shape as the classic
         loop's): diagnosis + flight tail, guard trip, then a bounded
         emergency checkpoint from the stamped pre-dispatch state."""
-        extra = None
+        extra = {}
         if rec.enabled:
             try:
-                extra = {"recent_spans": rec.tail()}
+                extra["recent_spans"] = rec.tail()
             except Exception:  # noqa: BLE001 — diagnostics only
                 log.exception("graftscope: flight tail unavailable")
-        watchdog.write_diagnosis(diag, model_dir, extra=extra)
+        if mw.enabled:
+            extra["memwatch"] = mw.report()     # cached, no device reads
+        watchdog.write_diagnosis(diag, model_dir, extra=extra or None)
         guard.request("watchdog")
         with cond:
             cond.notify_all()        # wake any blocked queue wait
@@ -1528,13 +1608,15 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
         """Actor-side stall response: diagnosis + guard trip only — the
         learner (main) thread owns the checkpointable state and will
         write the emergency save on its own exit path."""
-        extra = None
+        extra = {}
         if rec.enabled:
             try:
-                extra = {"recent_spans": rec.tail()}
+                extra["recent_spans"] = rec.tail()
             except Exception:  # noqa: BLE001 — diagnostics only
                 pass
-        watchdog.write_diagnosis(diag, model_dir, extra=extra)
+        if mw.enabled:
+            extra["memwatch"] = mw.report()
+        watchdog.write_diagnosis(diag, model_dir, extra=extra or None)
         guard.request("watchdog-actor")
         with cond:
             cond.notify_all()
@@ -1552,6 +1634,15 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
         log.info(f"dispatch watchdogs armed (actor + learner): timeout="
                  f"{res.dispatch_timeout}s, grace={res.stall_grace_s}s")
     ladder = watchdog.DegradationLadder(res.max_restores)
+    if pulse is not None:
+        if wd is not None:
+            pulse.wire_watchdog(wd, side="learner")
+        if wd_actor is not None:
+            pulse.wire_watchdog(wd_actor, side="actor")
+        pulse.wire_guard(guard)
+        pulse.set("backend_info", 1, backend=jax.default_backend())
+        pulse.set("queue_slots", sb.queue_slots)
+        pulse.set("staleness_bound", sb.staleness)
 
     # ---- watched-dispatch helpers (both threads) ----------------------
     def _watched(phase, state=None, awd=None, t=0, **meta):
@@ -1812,6 +1903,10 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                                 guard=guard)
                 if guard.triggered:
                     break
+                if pulse is not None:
+                    pulse.tick_iteration(t_env, episode)
+                if trc is not None:
+                    trc.poll(t_env)
                 # queue.get: wait for an item (or producer exit), then
                 # gather the slot straight into the replay ring. Span
                 # only (no stamp): an empty queue is the actor being
@@ -1880,6 +1975,8 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                     cond.notify_all()
 
                 _cadences()
+                if trc is not None:
+                    trc.tick(logger, t_env)
             return ("failed", failed) if failed is not None else \
                 ("done", None)
         except watchdog.DispatchFailed as df:
@@ -1956,6 +2053,7 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                     raise _NonFiniteEscalation(nonfinite_streak)
             with cond:
                 depth = counters["put"] - counters["got"]
+                ahead = counters["started"] - counters["consumed"]
             logger.log_stat("queue_depth", depth, t_env)
             logger.log_stat("actor_idle_s", round(idle["actor_s"], 3),
                             t_env)
@@ -1969,12 +2067,28 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                 logger.log_stat("dispatch_faults", dispatch_faults, t_env)
             logger.log_stat("episode", episode, t_env)
             now = time.time()
+            rate = None
             if last_log_time is not None:
-                logger.log_stat(
-                    "env_steps_per_sec",
-                    (t_env - last_log_t) / max(now - last_log_time, 1e-9),
-                    t_env)
+                rate = ((t_env - last_log_t)
+                        / max(now - last_log_time, 1e-9))
+                logger.log_stat("env_steps_per_sec", rate, t_env)
             last_log_time = now
+            pulse_snap = mw.snapshot("log", t_env=t_env)
+            if pulse is not None:
+                # the decoupled loop's live utilization surface: queue
+                # depth, params staleness in flight, both sides' idle
+                if rate is not None:
+                    pulse.set("env_steps_per_sec", rate)
+                pulse.set("queue_depth", depth)
+                pulse.set("staleness_in_flight", ahead)
+                pulse.set("actor_idle_seconds", round(idle["actor_s"], 3))
+                pulse.set("learner_idle_seconds",
+                          round(idle["learner_s"], 3))
+                pulse.set("nonfinite_streak", nonfinite_streak)
+                pulse.set("dispatch_faults", dispatch_faults)
+                pulse.set("ladder_failures", ladder.failures)
+                pulse.set("restores", restores)
+                pulse.set_memwatch(pulse_snap)
             logger.print_recent_stats()
             last_log_t = t_env
 
@@ -2019,7 +2133,8 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                 restores += 1
                 last_log_t = last_save_t = t_env
                 continue
-            rec.persist(os.path.join(model_dir, "flight_recorder.json"))
+            _persist_flight(os.path.join(model_dir,
+                                         "flight_recorder.json"))
             diag = wd.take_diagnosis() if wd is not None else None
             raise RuntimeError(
                 f"sebulba dispatch failure exhausted the degradation "
@@ -2029,7 +2144,7 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
     except BaseException as e:
         rec.mark("crash", t_env=t_env,
                  error=f"{type(e).__name__}: {e}"[:300])
-        rec.persist(os.path.join(results_dir, "flight_recorder.json"))
+        _persist_flight(os.path.join(results_dir, "flight_recorder.json"))
         rec.close()
         raise
     finally:
@@ -2041,11 +2156,13 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
         if wd_actor is not None:
             wd_actor.stop()
         guard.uninstall()
+        if pulse is not None:
+            pulse.close()
 
     ts = _snapshot_state() or seb.join(rs0, ls)
     if guard.triggered:
         rec.mark("shutdown", t_env=t_env, signame=guard.signame or "")
-        rec.persist(os.path.join(results_dir, "flight_recorder.json"))
+        _persist_flight(os.path.join(results_dir, "flight_recorder.json"))
         stall = (wd.take_diagnosis() if wd is not None else None) or \
                 (wd_actor.take_diagnosis() if wd_actor is not None
                  else None)

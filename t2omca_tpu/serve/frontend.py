@@ -33,6 +33,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import time
 from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
@@ -92,7 +93,8 @@ class ServeFrontend:
     program cache is not locked — shard frontends per thread)."""
 
     def __init__(self, artifact_dir: str, meta: dict, mac, params,
-                 dtype: str, use_exported: bool, rec) -> None:
+                 dtype: str, use_exported: bool, rec,
+                 hub=None) -> None:
         self.artifact_dir = artifact_dir
         self.meta = meta
         self.dtype = dtype
@@ -104,6 +106,13 @@ class ServeFrontend:
         self._mac = mac
         self._params = params
         self._rec = rec
+        # graftpulse MetricsHub (obs/pulse.py, docs/OBSERVABILITY.md
+        # §pulse): None (default) = zero extra work per request; set =
+        # the scrapeable per-engine surface the fleet-scale story
+        # (ROADMAP item 4, EnvPool share-nothing engines) load-balances
+        # on — sliding-window select p50/p99, per-bucket request/row
+        # counters (padding occupancy), session-LRU fill
+        self._hub = hub
         self._use_exported = use_exported
         self._steps: Dict[int, object] = {}
         self._fallback = None
@@ -113,7 +122,7 @@ class ServeFrontend:
     @classmethod
     def load(cls, artifact_dir: str, dtype: str = "float32",
              use_exported: bool = True, compile_cache: bool = True,
-             rec=NULL_RECORDER) -> "ServeFrontend":
+             rec=NULL_RECORDER, hub=None) -> "ServeFrontend":
         """Load an exported artifact (``serve/export.py`` layout).
         ``dtype`` picks the param variant; ``compile_cache`` points the
         persistent compile cache at the artifact's warm entries
@@ -172,7 +181,7 @@ class ServeFrontend:
                     f"{env_info['obs_shape']}/{env_info['n_actions']}) "
                     f"— corrupt meta.json?")
         return cls(artifact_dir, meta, mac, params, dtype, use_exported,
-                   rec)
+                   rec, hub=hub)
 
     # --------------------------------------------------------- programs
 
@@ -240,6 +249,7 @@ class ServeFrontend:
         bmax = self.buckets[-1]
         actions_out = np.empty((n, self.n_agents), np.int32)
         hidden_out = np.empty((n, self.n_agents, self.emb), np.float32)
+        t_req0 = time.perf_counter() if self._hub is not None else 0.0
         for lo in range(0, n, bmax):
             hi = min(lo + bmax, n)
             cn = hi - lo
@@ -255,6 +265,19 @@ class ServeFrontend:
             with _watched("serve.unpad", self._rec, bucket=bucket):
                 actions_out[lo:hi] = a_host[:cn]
                 hidden_out[lo:hi] = h_host[:cn]
+            if self._hub is not None:
+                # per-bucket occupancy counters: rows/ (dispatches ×
+                # bucket) is the padding-waste read the bucket tuning
+                # needs — one inc pair per compiled dispatch
+                self._hub.inc("serve_dispatches_total", bucket=bucket)
+                self._hub.inc("serve_rows_total", cn, bucket=bucket)
+        if self._hub is not None:
+            # whole-request latency into the sliding window: /metrics
+            # renders serve_select_ms_p50/_p99 at scrape time
+            self._hub.observe(
+                "serve_select_ms",
+                (time.perf_counter() - t_req0) * 1000.0)
+            self._hub.inc("serve_requests_total")
         return actions_out, hidden_out
 
     def warmup(self) -> None:
@@ -300,6 +323,14 @@ class SessionStore:
             self._h[s] = hidden2[i]
         while len(self._h) > self._max:
             self._h.pop(next(iter(self._h)))
+        hub = getattr(fe, "_hub", None)     # duck-typed frontends (tests)
+        if hub is not None:
+            # LRU fill fraction: 1.0 means evictions are live and
+            # long-lived sessions silently restart from zero hiddens —
+            # the signal to widen max_sessions before quality decays
+            hub.set("serve_sessions", len(self._h))
+            hub.set("serve_session_lru_fill",
+                    len(self._h) / self._max if self._max else 1.0)
         return actions
 
     def end(self, session_id) -> None:

@@ -16,6 +16,9 @@ One helper so the semantics can't drift between copies:
 Raises propagate (``OSError``/``TypeError``/``ValueError``) — each
 call site owns its best-effort policy (warn, or return None).
 stdlib-only: the jax-free report CLI imports through here.
+
+``read_jsonl_tolerant`` is the read-side counterpart: the post-mortem
+CLIs must read past the torn final line a killed run leaves.
 """
 
 from __future__ import annotations
@@ -24,6 +27,47 @@ import json
 import os
 import threading
 from typing import Any, Callable
+
+
+def read_jsonl_tolerant(path: str,
+                        on_bad: "Callable[[int, bool], None] | None" = None
+                        ) -> list:
+    """Parse a JSONL file, skipping unparseable lines instead of
+    raising. A run killed mid-write (crash, SIGKILL, hard watchdog
+    exit) leaves exactly one torn artifact: a truncated FINAL line —
+    and the post-mortem readers (``obs report``, ``obs timeline``) must
+    read past it, because that torn tail is precisely the file a dead
+    run leaves. ``on_bad(line_no, is_last)`` is invoked per skipped
+    line (1-based; ``is_last`` distinguishes the expected torn tail
+    from mid-file corruption) — callers print their own warning.
+    Raises ``OSError`` only when the file itself cannot be read.
+
+    Streams with a one-line lookahead (the ``is_last`` flag needs it)
+    instead of slurping: the post-mortem CLIs read long runs'
+    metrics.jsonl on exactly the constrained hosts where materializing
+    the raw lines alongside the parsed events would hurt."""
+    out = []
+
+    def consume(line: str, line_no: int, is_last: bool) -> None:
+        line = line.strip()
+        if not line:
+            return
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            if on_bad is not None:
+                on_bad(line_no, is_last)
+
+    with open(path) as f:
+        prev = None
+        prev_no = 0
+        for i, line in enumerate(f):
+            if prev is not None:
+                consume(prev, prev_no, False)
+            prev, prev_no = line, i + 1
+        if prev is not None:
+            consume(prev, prev_no, True)
+    return out
 
 
 def write_json_atomic(path: str, payload: Any,

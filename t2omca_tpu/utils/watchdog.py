@@ -271,6 +271,7 @@ class Watchdog:
         self._thread: Optional[threading.Thread] = None
         # armed stamp: (generation, phase, t_env, state, monotonic since)
         self._gen = 0
+        self._beat = time.monotonic()   # last stamp/clear (pulse telemetry)
         self._armed: Optional[tuple] = None
         self._fired_gen = -1
         self._completed: set = set()    # phases with ≥1 clean completion
@@ -307,8 +308,9 @@ class Watchdog:
         call stalls (pass None when no consistent state exists)."""
         with self._lock:
             self._gen += 1
+            self._beat = time.monotonic()
             self._armed = (self._gen, phase, int(t_env), state,
-                           time.monotonic())
+                           self._beat)
 
     def clear(self, completed: bool = True) -> None:
         """Disarm (the call returned). Drops the state reference.
@@ -319,11 +321,30 @@ class Watchdog:
             if completed and self._armed is not None:
                 self._completed.add(self._armed[1])
             self._gen += 1
+            self._beat = time.monotonic()
             self._armed = None
 
     def watch(self, phase: str, t_env: int = 0, state: Any = None):
         """Context manager: ``stamp`` on entry, ``clear`` on exit."""
         return _Watch(self, phase, t_env, state)
+
+    def heartbeat(self) -> dict:
+        """Live telemetry snapshot for the pulse plane (obs/pulse.py,
+        docs/OBSERVABILITY.md §pulse): the armed phase and how long its
+        call has been in flight, the age of the last heartbeat (any
+        stamp or clear), and the cumulative stall count. Read-only and
+        lock-bounded — safe from the HTTP scrape thread while the main
+        thread is wedged inside the armed call (that is the read the
+        endpoint exists for)."""
+        now = time.monotonic()
+        with self._lock:
+            armed = self._armed
+            out = {"armed_phase": armed[1] if armed is not None else None,
+                   "armed_s": (round(now - armed[4], 3)
+                               if armed is not None else 0.0),
+                   "beat_age_s": round(now - self._beat, 3),
+                   "stall_count": self.stall_count}
+        return out
 
     def take_diagnosis(self) -> Optional[StallDiagnosis]:
         """Consume the latest stall diagnosis (None if none fired).
