@@ -578,8 +578,18 @@ def bench_kernels(make_cfg_kernels, _time, args) -> int:
     The leg forces the DENSE acting path: MultiHeadAttention — the
     program the kernel switch selects — is what the dense rollout scan
     dispatches; the qslice/entity fast paths bypass it by construction,
-    so an A/B over them would measure nothing."""
+    so an A/B over them would measure nothing.
+
+    Each mode ALSO measures a TRAIN-STEP leg (PR 13): the jitted
+    ``train_iter`` (sample → learner update → priority feedback) over a
+    ring pre-filled from the rollout, one ``train_iters_per_sec`` record
+    per mode — under ``pallas`` the learner's backward lowers through
+    the flash backward kernels, which is the half of the A/B the
+    rollout number can't see. Rides the ``--daemon`` matrix through the
+    existing ``--kernels ab`` leg, so the next TPU window measures the
+    backward kernel too."""
     import jax
+    import jax.numpy as jnp
 
     from t2omca_tpu.run import Experiment
 
@@ -620,6 +630,44 @@ def bench_kernels(make_cfg_kernels, _time, args) -> int:
                        else args.config),
             "n_envs": cfg.batch_size_run,
             "episode_steps": cfg.env_args.episode_limit,
+        })), flush=True)
+
+        # ---- train-step leg: fill the ring from the measured rollout,
+        # then time the UNdonated train_iter on a fixed state (donation
+        # would delete the inputs the next repetition re-times)
+        tlabel = f"{label}-train"
+        _, insert, train_iter = exp.jitted_programs()
+        with _REC.span("bench.compile", leg=tlabel):
+            buf_state = ts.buffer
+            fills = -(-cfg.batch_size // cfg.batch_size_run)
+            for _ in range(max(fills, 1)):
+                buf_state = insert(buf_state, batch)
+            ts_fill = ts.replace(buffer=buf_state)
+            key = jax.random.PRNGKey(0)
+            t_env = jnp.asarray(env_steps)
+            _, info = train_iter(ts_fill, key, t_env)
+            _sync(info["loss"])
+
+        def one_train(train_iter=train_iter, ts_fill=ts_fill, key=key,
+                      t_env=t_env):
+            _, info = train_iter(ts_fill, key, t_env)
+            return info["loss"]
+
+        with _REC.span("bench.measure", leg=tlabel):
+            dt_train = _time(one_train)
+        print(f"# kernels={mode}: train_iter {dt_train * 1e3:.1f} ms "
+              f"(batch {cfg.batch_size} episodes, dense learner unroll)",
+              file=sys.stderr)
+        print(json.dumps(_finalize({
+            "metric": "train_iters_per_sec",
+            "value": round(1.0 / dt_train, 2),
+            "unit": "train-iters/s/chip",
+            "vs_baseline": None,
+            "kernels": mode,
+            "leg": tlabel,
+            "train_batch_episodes": cfg.batch_size,
+            "config": (None if args.smoke or args.envs or args.steps
+                       else args.config),
         })), flush=True)
     return rc
 

@@ -131,6 +131,16 @@ TRACE_SYMBOLS = {
     "attn_xla": ("jit__attn_xla", "PjitFunction(_attn_xla)"),
     "attn_pallas": ("jit__attn_pallas", "PjitFunction(_attn_pallas)",
                     "flash_attention_kernel"),
+    # the flash BACKWARD kernels (PR 13). Inside a train trace the two
+    # backward pallas programs show up as their Mosaic kernel-launch
+    # names — listed so learner-side backward device time is attributed
+    # instead of dropping into the unattributed bucket. (The substring
+    # "flash_attention_kernel" does NOT match these names, so forward
+    # and backward attribution can't cross-count.)
+    "attn_pallas_bwd": ("jit__attn_pallas_bwd",
+                        "PjitFunction(_attn_pallas_bwd)",
+                        "flash_attention_bwd_dq_kernel",
+                        "flash_attention_bwd_dkv_kernel"),
     # graftworld parameterized env programs (envs/graftworld.py). Like
     # the attention kernels these jit symbols appear only in standalone
     # dispatches (the audit, micro-benches) — inside a rollout the env
@@ -160,6 +170,57 @@ def audit_config():
                           mixer_heads=2, mixer_depth=1, dtype="bfloat16"),
         replay=ReplayConfig(buffer_size=8),
     ))
+
+
+def kernels_audit_config(attention: str = "xla"):
+    """The frozen config for the KERNEL-MODE byte comparison
+    (``train_iter_pallas``/``learner_train_pallas`` vs their ``_ref``
+    einsum twins): the ``audit_config`` recipe at token counts where the
+    attention logits tensor is material. At the shared tiny audit scale
+    (3 AGVs, 7 tokens) the ``(S, R·H, T)`` logits the flash path
+    eliminates are a few hundred bytes inside a ~2 MB program — the
+    comparison would measure interpreter scaffolding, not the kernel.
+    16 AGVs / 4 MECs / emb 16 puts the mixer attention at ~19 query
+    rows × 2 heads against ~39 keys, where the eliminated forward
+    logits + backward recompute dominate the mode delta and the
+    lowered-level GP302 ratchet pins pallas STRICTLY below xla
+    (tests/test_graftprog.py). Lowered level only — a compiled
+    comparison on the CPU gate would measure the interpret-mode grid
+    emulation (serial block copies the Mosaic lowering never performs),
+    not the program structure."""
+    from ..config import (EnvConfig, KernelsConfig, ModelConfig,
+                          ReplayConfig, TrainConfig, sanity_check)
+    return sanity_check(TrainConfig(
+        batch_size_run=2, batch_size=4, superstep=AUDIT_SUPERSTEP_K,
+        env_args=EnvConfig(agv_num=16, mec_num=4, num_channels=2,
+                           episode_limit=6, fast_norm=False),
+        model=ModelConfig(emb=16, heads=2, depth=1, mixer_emb=16,
+                          mixer_heads=2, mixer_depth=1, dtype="bfloat16"),
+        replay=ReplayConfig(buffer_size=8),
+        kernels=KernelsConfig(attention=attention),
+    ))
+
+
+_kctx: Dict[str, AuditContext] = {}
+
+
+def kernels_audit_context(attention: str) -> AuditContext:
+    """Build (once per process, per kernel mode) the kernel-comparison
+    audit context — same caching rationale as ``audit_context``; the
+    run.py and learner hooks each consume both modes."""
+    with _ctx_lock:
+        if attention not in _kctx:
+            import jax
+
+            from ..run import Experiment
+            cfg = kernels_audit_config(attention)
+            exp = Experiment.build(cfg)
+            ts_shape = jax.eval_shape(lambda: exp.init_train_state(
+                cfg.seed))
+            _kctx[attention] = AuditContext(
+                cfg=cfg, exp=exp, ts_shape=ts_shape,
+                superstep_k=AUDIT_SUPERSTEP_K)
+        return _kctx[attention]
 
 
 def audit_context(rebuild: bool = False) -> AuditContext:

@@ -1,11 +1,20 @@
-"""Host-RAM prioritized replay with a native sum-tree index.
+"""Host-RAM prioritized replay with a device-side stratified PER sample.
 
 The reference's ``buffer_cpu_only`` flag keeps replay on CPU and moves only
 sampled batches to the accelerator (``/root/reference/per_run.py:143-146``,
 ``:229-230``). This is that mode for the TPU framework: episode storage in
-pinned host NumPy (capacity bounded by RAM, not HBM), priorities in the
-C++ sum-tree (``native/sumtree.cpp``, O(log n) set/sample via ctypes), and a
-pure-NumPy ``PySumTree`` fallback when no g++ toolchain exists.
+pinned host NumPy (capacity bounded by RAM, not HBM) while the PER *index*
+lives on device — a mirrored ``(capacity,)`` f32 priority vector sampled by
+one jitted stratified inverse-CDF program (the episode-buffer formulation,
+``components/episode_buffer.PrioritizedReplayBuffer.sample``). The
+pre-PR-13 implementation kept priorities in a C++ sum-tree
+(``native/sumtree.cpp``) and paid a ctypes crossing per sample; the
+steady-state sample path now runs ZERO sum-tree calls — priority writes are
+O(batch) scatters into both mirrors, sampling is one device dispatch whose
+importance weights feed the train step without ever visiting the host. The
+``PySumTree``/``NativeSumTree`` classes remain as the reference formulation
+the parity tests pin sampled indices and weights against
+(``tests/test_host_replay.py``).
 
 Same method surface as the device buffers (insert / can_sample / sample /
 update_priorities) so the driver only branches on ``is_host`` to skip
@@ -13,22 +22,32 @@ jitting the buffer stages. Sampling semantics match the device PER:
 stratified inverse-CDF over ``p^alpha``, importance weights ``(N·P)^-beta``
 max-normalized, beta annealed to 1 over ``t_max`` (Q9 priorities flow back
 per sampled episode).
+
+Priorities are stored-space ``p^alpha`` at f32 — the device mirror cannot
+hold the old tree's f64, and |TD|-scale priorities fit f32 with orders of
+headroom; the host mirror keeps the SAME f32 values so the two can never
+drift (pinned by test). ``max_priority`` tracking stays a host f64 float.
 """
 
 from __future__ import annotations
 
 import ctypes
 import dataclasses
+import functools
 from typing import Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .episode_buffer import EpisodeBatch
 
 
 class PySumTree:
-    """NumPy fallback with the same operations as the native tree."""
+    """NumPy sum-tree formulation. Since PR 13 this is no longer on the
+    live sample path — it survives (with ``NativeSumTree``) as the
+    reference formulation the device-sample parity tests pin indices
+    and weights against."""
 
     def __init__(self, cap: int):
         self.cap = cap
@@ -53,7 +72,9 @@ class PySumTree:
 
 
 class NativeSumTree:
-    """ctypes wrapper over native/sumtree.cpp (extern "C" ABI)."""
+    """ctypes wrapper over native/sumtree.cpp (extern "C" ABI). Parity
+    reference only since PR 13 (see ``PySumTree``) — the steady-state
+    sample path runs zero ctypes crossings."""
 
     def __init__(self, cap: int):
         from ..native import load_sumtree
@@ -104,11 +125,74 @@ class NativeSumTree:
         return out_idx, out_pri
 
 
-def _make_tree(cap: int):
-    try:
-        return NativeSumTree(cap)
-    except Exception:
-        return PySumTree(cap)
+# ------------------------------------------------- device sample programs
+#
+# Module-level jitted programs shared by every HostReplayBuffer instance
+# (one compile per (capacity, batch) aval set). The priority vector is the
+# only state they touch; everything else stays in host RAM.
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _mirror_set(pri: jnp.ndarray, idx: jnp.ndarray,
+                vals: jnp.ndarray) -> jnp.ndarray:
+    """O(batch) scatter into the device priority mirror (donated: XLA
+    updates the capacity-length vector in place instead of copying it
+    per insert/priority-feedback)."""
+    return pri.at[idx].set(vals)
+
+
+def _valid_mass(pri: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
+    """Zero the unfilled (and any poisoned) tail: only slots < n carry
+    sampling mass, exactly like the device buffer's ``_probs`` mask —
+    garbage beyond the fill line can never leak into indices or
+    weights."""
+    return jnp.where(jnp.arange(pri.shape[0]) < n, pri, 0.0)
+
+
+def _weights_from_mass(p: jnp.ndarray, idx: jnp.ndarray, n: jnp.ndarray,
+                       beta: jnp.ndarray) -> jnp.ndarray:
+    """Max-normalized ``(N·P)^-beta`` over the already-masked priority
+    mass — ONE definition consumed by both the live sample program and
+    the sum-tree parity reference, so weight parity is pinned through
+    the identical lowering rather than through cross-library float
+    accidents (numpy and XLA powf differ in the last ulp). The
+    ``p.sum()`` here is deliberately NOT replaced by the sampler's
+    ``cdf[-1]``: the standalone ``_importance_weights`` entry point has
+    no cdf, and the two reductions associate differently in f32 — one
+    extra O(capacity) reduce buys the bit-identical shared form."""
+    probs = p[idx] / jnp.maximum(p.sum(), 1e-12)
+    nn = jnp.maximum(n, 1).astype(jnp.float32)
+    w = (nn * jnp.maximum(probs, 1e-12)) ** (-beta)
+    return w / jnp.maximum(w.max(), 1e-12)
+
+
+@jax.jit
+def _importance_weights(pri: jnp.ndarray, idx: jnp.ndarray,
+                        n: jnp.ndarray, beta: jnp.ndarray) -> jnp.ndarray:
+    """Standalone entry point over the RAW mirror (tests evaluate it at
+    the sum-tree's own sampled indices)."""
+    return _weights_from_mass(_valid_mass(pri, n), idx, n, beta)
+
+
+@jax.jit
+def _stratified_sample(pri: jnp.ndarray, us: jnp.ndarray, n: jnp.ndarray,
+                       beta: jnp.ndarray
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The sum-tree's stratified inverse-CDF sample as one device
+    program: one uniform per equal-mass stratum, ``searchsorted`` over
+    the masked priority cdf (side="right", the tree-descent convention),
+    clamped to the last VALID slot so the exact-right-edge float
+    artifact (``u·total == total``) resolves inside the fill line — the
+    ctypes path redrew there instead; with zero mass on the invalid tail
+    the clamp is the only reachable difference and it is measure-zero.
+    Indices are pinned bit-equal to ``NativeSumTree``/``PySumTree``
+    sampling at the same uniforms (tests/test_host_replay.py)."""
+    p = _valid_mass(pri, n)
+    cdf = jnp.cumsum(p)
+    bs = us.shape[0]
+    u = (jnp.arange(bs, dtype=jnp.float32) + us) / bs * cdf[-1]
+    idx = jnp.searchsorted(cdf, u, side="right")
+    idx = jnp.minimum(idx, jnp.maximum(n - 1, 0))
+    return idx, _weights_from_mass(p, idx, n, beta)
 
 
 @dataclasses.dataclass
@@ -145,7 +229,12 @@ class HostReplayBuffer:
             terminated=np.zeros((cap, t), bool),
             filled=np.zeros((cap, t), bool),
         )
-        self._tree = _make_tree(cap)
+        # stored-space p^alpha, twin-mirrored: host f32 (checkpoint /
+        # introspection / parity tests) and device f32 (the sample
+        # program's operand). Writes go through _set_priorities so the
+        # two can never drift; the device copy is donated in place.
+        self._pri = np.zeros(cap, np.float32)
+        self._pri_dev = jnp.zeros(cap, jnp.float32)
         self._pos = 0
         self._count = 0
         self._max_priority = 1.0
@@ -163,7 +252,7 @@ class HostReplayBuffer:
         # exactly the slots this batch reuses, and flushing after would
         # stamp the EVICTED episodes' |TD| onto the fresh episodes
         # (which must start at max_priority) — flushing here keeps the
-        # sum-tree byte-identical to the old synchronous update order
+        # priority mirrors byte-identical to the old synchronous order
         self.flush_priority_updates()
         host = jax.device_get(batch)
         b = host.obs.shape[0]
@@ -173,10 +262,17 @@ class HostReplayBuffer:
             getattr(self._storage, name)[idx] = np.asarray(
                 getattr(host, name), getattr(self._storage, name).dtype)
         if self.prioritized:
-            self._tree.set_batch(idx, np.full(
-                b, self._max_priority ** self.alpha))
+            self._set_priorities(idx, np.full(
+                b, self._max_priority ** self.alpha, np.float32))
         self._pos = int((self._pos + b) % self.capacity)
         self._count = int(min(self._count + b, self.capacity))
+
+    def _set_priorities(self, idx: np.ndarray, vals: np.ndarray) -> None:
+        """O(batch) priority write into BOTH mirrors (same f32 values —
+        the host array is the device vector's byte-twin)."""
+        self._pri[idx] = vals
+        self._pri_dev = _mirror_set(self._pri_dev, jnp.asarray(idx),
+                                    jnp.asarray(vals))
 
     def can_sample(self, batch_size: int) -> bool:
         return self._count >= batch_size
@@ -207,14 +303,15 @@ class HostReplayBuffer:
         """Abandon deferred priority feedback WITHOUT consuming it. The
         driver's checkpoint restore calls this when the train step that
         produced the refs was rolled back — fetching them would stamp the
-        abandoned computation's |TD| into the sum-tree, or re-raise a
-        fault from a poisoned device array outside any ladder routing."""
+        abandoned computation's |TD| into the priority mirrors, or
+        re-raise a fault from a poisoned device array outside any ladder
+        routing."""
         self._pending_update = None
 
     def flush_priority_updates(self) -> None:
         """Consume the deferred priority feedback, if any. A tripped
-        (non-finite) train step leaves the sum-tree untouched — NaN
-        priorities would corrupt it permanently."""
+        (non-finite) train step leaves the priority mirrors untouched —
+        NaN mass would corrupt the sampling cdf permanently."""
         if self._pending_update is None:
             return
         idx, td_ref, finite_ref = self._pending_update
@@ -223,41 +320,39 @@ class HostReplayBuffer:
             td = np.asarray(jax.device_get(td_ref), np.float64)
             self.update_priorities(idx, td + 1e-6)             # Q9
 
+    def _beta(self, t_env: int) -> np.float32:
+        """Annealed beta, computed host-side (t_env is a host int on
+        this path) and cast ONCE to the f32 the sample program runs in."""
+        return np.float32(self.beta0 + (1.0 - self.beta0) * min(
+            max(float(t_env) / self.t_max, 0.0), 1.0))
+
     def sample(self, batch_size: int, t_env: int
                ) -> Tuple[EpisodeBatch, np.ndarray, np.ndarray]:
         self.flush_priority_updates()
         n = self._count
         if self.prioritized:
-            us = self._rng.random(batch_size)
-            idx, pri_a = self._tree.sample(us)
-            # unfilled slots carry zero priority, so a hit there can only be
-            # an exact right-edge float artifact (u·total == total): redraw
-            # instead of clamping, which would silently over-sample the last
-            # valid episode; persistent hits mean corrupted bookkeeping
-            oob = idx >= n
-            tries = 0
-            while oob.any():
-                if tries >= 3:
-                    raise RuntimeError(
-                        "sum-tree repeatedly sampled unfilled slots — "
-                        "priority bookkeeping is corrupted")
-                ridx, rpri = self._tree.sample(
-                    self._rng.random(int(oob.sum())))
-                idx[oob], pri_a[oob] = ridx, rpri
-                oob = idx >= n
-                tries += 1
-            total = self._tree.total()
-            probs = pri_a / max(total, 1e-12)
-            beta = self.beta0 + (1.0 - self.beta0) * min(
-                max(float(t_env) / self.t_max, 0.0), 1.0)
-            w = (n * np.maximum(probs, 1e-12)) ** (-beta)
-            w = (w / max(w.max(), 1e-12)).astype(np.float32)
+            # host RNG keeps the stratum uniforms (one tiny h2d per
+            # sample); index selection + importance weights run as ONE
+            # device program over the mirrored priority vector — the
+            # steady-state path executes zero sum-tree ctypes calls.
+            # The uniforms are cast to f32 BEFORE use so the parity
+            # reference (the f64 sum-tree formulation) sees the exact
+            # same values under lossless promotion.
+            us = self._rng.random(batch_size).astype(np.float32)
+            idx_dev, w = _stratified_sample(
+                self._pri_dev, jnp.asarray(us),
+                jnp.asarray(n, jnp.int32), jnp.asarray(self._beta(t_env)))
+            # the episode gather reads host RAM, so the indices must
+            # come home — a batch_size-int fetch, the path's one
+            # unavoidable d2h (the weights stay on device and feed the
+            # train step directly)
+            idx = np.asarray(jax.device_get(idx_dev), np.int64)
         else:
             idx = self._rng.choice(n, size=batch_size, replace=False)
-            w = np.ones(batch_size, np.float32)
+            w = jax.numpy.ones(batch_size, jax.numpy.float32)
         batch = jax.tree.map(lambda s: jax.numpy.asarray(s[idx]),
                              self._storage)
-        return batch, idx, jax.numpy.asarray(w)
+        return batch, idx, w
 
     def update_priorities(self, idx: np.ndarray,
                           priorities: np.ndarray) -> None:
@@ -265,4 +360,5 @@ class HostReplayBuffer:
             return
         pri = np.asarray(jax.device_get(priorities), np.float64)
         self._max_priority = float(max(self._max_priority, pri.max()))
-        self._tree.set_batch(np.asarray(idx, np.int64), pri ** self.alpha)
+        self._set_priorities(np.asarray(idx, np.int64),
+                             (pri ** self.alpha).astype(np.float32))

@@ -145,7 +145,8 @@ class BasicMAC:
     def forward_qslice(self, params, obs: jnp.ndarray, hidden: jnp.ndarray,
                        key: jax.Array | None = None,
                        deterministic: bool = True,
-                       acting: bool = False
+                       acting: bool = False,
+                       attn_impl: str | None = None
                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Exact token-0-only forward over the same param tree
         (ops/query_slice). Plain jnp, differentiable — also used by the
@@ -153,7 +154,13 @@ class BasicMAC:
         the q-head). ``params`` may be the raw tree or a
         ``prepare_acting_params`` result; ``acting=True`` computes in the
         act_dtype (and must be paired with the acting-dtype fold — the
-        folded tree short-circuits the per-call fold)."""
+        folded tree short-circuits the per-call fold).
+
+        ``attn_impl`` selects the sliced-attention lowering
+        (``kernels.attention``); ``None`` keeps the einsum path, so
+        acting, serving and every legacy caller stay byte-identical —
+        ONLY the learner unroll passes the config switch (the flash
+        kernel's win is the train-path backward, docs/PERF.md)."""
         from ..ops.query_slice import agent_forward_qslice
         a = self.agent
         return agent_forward_qslice(
@@ -162,7 +169,8 @@ class BasicMAC:
             heads=a.heads, depth=a.depth, n_actions=a.n_actions,
             standard_heads=a.standard_heads,
             dtype=self._acting_dtype if acting else a.dtype,
-            noise_key=self._noise_key(key, deterministic))
+            noise_key=self._noise_key(key, deterministic),
+            attn_impl=attn_impl or "xla")
 
     def forward_entity(self, params, compact, hidden: jnp.ndarray,
                        key: jax.Array | None = None,

@@ -25,16 +25,33 @@ Numerics contract (pinned by ``tests/test_kernels.py``):
   einsum path produces (an additive bias would silently cancel in the
   softmax). Causal positions use the same finite value; ``exp``
   underflows those contributions to exactly 0.0 in both paths.
-* **Differentiable everywhere**: the backward pass recomputes the
-  reference einsum attention and takes its VJP (a custom VJP — Pallas
-  primitives have no transpose rule), so the learner's dense unroll can
-  train straight through the kernel with gradients identical to the
-  einsum path evaluated at the same inputs.
+* **Differentiable everywhere, flash both ways**: the forward pass
+  under ``jax.grad`` additionally emits per-row softmax residuals —
+  the running max ``m`` and denominator ``l``, kept SEPARATE rather
+  than fused into one logsumexp so the all-masked-row degenerate case
+  survives f32 (``m = −1e9`` swallows ``log l`` at f32 resolution;
+  ``exp(s − m) / l`` does not) — and the backward pass recomputes the
+  probability tiles in VMEM from ``(q, k, residuals)`` to produce
+  ``dq/dk/dv`` without ever materializing the logits or P in HBM.
+  The pre-PR-13 VJP instead re-ran the reference einsum attention in
+  the backward, paying the exact ``(B·A, H, Q, K)`` HBM round-trip the
+  forward kernel exists to kill — on the learner unrolls (where the
+  agent/mixer transformers burn most FLOPs per dispatch) that write
+  dominated train-step memory traffic. Gradients equal the einsum
+  VJP's at the same inputs up to float reassociation (pinned at f32
+  ~1e-5; replacement-mask/causal/all-masked-row semantics identical).
+  Residual cost: O(B·H·Q) f32 per forward — two rows of statistics vs
+  the O(B·H·Q·K) P tensor the einsum VJP keeps alive.
 
 ``interpret=None`` (the default) auto-selects interpreter mode off-TPU,
 which is what makes the kernel testable in the CPU tier-1 gate and
-auditable by graftprog (the registered ``attn_pallas`` program lowers
-the interpret form on the gate's pinned CPU platform).
+auditable by graftprog (the registered ``attn_pallas``/
+``attn_pallas_bwd`` programs lower the interpret form on the gate's
+pinned CPU platform). Interpret mode also skips the TPU sublane/lane
+tile quanta (token counts pad only to the clamped block sizes, head dim
+not at all) — the kernel *body* is the one that lowers to Mosaic, but
+off-TPU there is no hardware tiling to satisfy and the padding would
+only inflate the audit's cost model with work the chip never does.
 """
 
 from __future__ import annotations
@@ -66,7 +83,10 @@ _PAD_VALUE = -1e30
 #: matches the MXU/VPU lane width
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
-#: sublane quantum that serves both f32 (8) and bf16 (16) tilings
+#: sublane quantum that serves both f32 (8) and bf16 (16) tilings.
+#: Applied only on real TPU lowerings — interpret mode (CPU gate) pads
+#: tokens to the clamped block size alone, so tiny audit shapes are not
+#: charged for pad rows Mosaic would process but the interpreter won't.
 _SUBLANE = 16
 #: MXU/VPU lane width — the last dim of every VMEM tile pads to this
 #: on real TPU lowerings (interpret mode skips the pad)
@@ -77,17 +97,35 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
+def _tile_geometry(t_q: int, t_k: int, d: int, block_q: int, block_k: int,
+                   interpret: bool):
+    """One source for the (clamped block, padded token, padded head)
+    geometry — the backward kernels must reuse the forward's exact
+    padding so the saved per-row residuals line up with the recomputed
+    tiles."""
+    quantum = 1 if interpret else _SUBLANE
+    bq = min(block_q, _round_up(t_q, quantum))
+    bk = min(block_k, _round_up(t_k, quantum))
+    t_q_pad = _round_up(t_q, bq)
+    t_k_pad = _round_up(t_k, bk)
+    d_pad = d if interpret else _round_up(d, _LANE)
+    return bq, bk, t_q_pad, t_k_pad, d_pad
+
+
 def _flash_attention_kernel(q_ref, k_ref, v_ref, *rest, causal: bool,
-                            has_bias: bool, t_k: int, t_k_pad: int,
-                            block_q: int, block_k: int):
+                            has_bias: bool, save_res: bool, t_k: int,
+                            t_k_pad: int, block_q: int, block_k: int):
     """One (batch, head, q-block) grid cell: online-softmax attention of
     a ``(block_q, d)`` query tile against all keys, k-tiled by
     ``block_k``. The ``(block_q, block_k)`` logits tile is the only
-    score buffer that ever exists."""
-    if has_bias:
-        bias_ref, o_ref = rest
-    else:
-        (o_ref,) = rest
+    score buffer that ever exists. With ``save_res`` the final running
+    max and denominator are emitted per row — the residuals the flash
+    backward recomputes P from."""
+    rest = list(rest)
+    bias_ref = rest.pop(0) if has_bias else None
+    o_ref = rest.pop(0)
+    if save_res:
+        m_ref, l_ref = rest
     q = q_ref[0, 0].astype(jnp.float32)                    # (bq, d)
     d = q.shape[-1]
     q_row0 = pl.program_id(2) * block_q
@@ -129,17 +167,145 @@ def _flash_attention_kernel(q_ref, k_ref, v_ref, *rest, causal: bool,
     m0 = jnp.full((block_q, 1), _PAD_VALUE, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, t_k_pad // block_k, body,
+    m, l, acc = jax.lax.fori_loop(0, t_k_pad // block_k, body,
                                   (m0, l0, acc0))
     o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    if save_res:
+        # m/l stay SEPARATE (not m + log l): in the all-masked-row case
+        # m is −1e9 and f32 addition swallows log l entirely, which
+        # would turn the backward's recomputed P into exp(0) = 1
+        # instead of the uniform 1/t_k the forward produced
+        m_ref[0, 0] = m[:, 0]
+        l_ref[0, 0] = l[:, 0]
+
+
+def _recompute_p(q, kb, bias_blk, m, l, row0, col0, causal: bool,
+                 t_k: int, block_q: int, block_k: int):
+    """Shared backward-tile recompute: the (block_q, block_k)
+    probability tile ``P = exp(S − m) / l`` with the forward's exact
+    replacement-mask/causal/pad semantics, plus the ``replaced`` plane
+    (positions whose logit the forward OVERWROTE — their softmax
+    cotangent is zero, exactly like the einsum path's
+    ``where(mask == 0, NEG_MASK_VALUE, logits)`` VJP)."""
+    s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    col = col0 + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    replaced = col >= t_k
+    if bias_blk is not None:
+        bmask = bias_blk != 0.0
+        s = jnp.where(bmask, bias_blk, s)
+        replaced = replaced | bmask
+    if causal:
+        row = row0 + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cmask = col > row
+        s = jnp.where(cmask, NEG_MASK_VALUE, s)
+        replaced = replaced | cmask
+    s = jnp.where(col >= t_k, _PAD_VALUE, s)
+    p = jnp.exp(s - m) / l
+    return p, replaced
+
+
+def _flash_attention_bwd_dq_kernel(q_ref, k_ref, v_ref, *rest,
+                                   causal: bool, has_bias: bool, t_k: int,
+                                   t_k_pad: int, block_q: int,
+                                   block_k: int):
+    """dQ for one (batch, head, q-block) grid cell: loop the key blocks,
+    recompute each P tile in VMEM from the saved residuals, accumulate
+    ``dQ = Σ_k dS · K`` with ``dS = P ∘ (dP − Δ)`` zeroed at replaced
+    positions. Neither the logits nor P ever reach HBM."""
+    rest = list(rest)
+    bias_ref = rest.pop(0) if has_bias else None
+    g_ref, m_ref, l_ref, delta_ref, dq_ref = rest
+    q = q_ref[0, 0].astype(jnp.float32)                    # (bq, d)
+    g = g_ref[0, 0].astype(jnp.float32)                    # (bq, d)
+    m = m_ref[0, 0][:, None]                               # (bq, 1) f32
+    l = l_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+    row0 = pl.program_id(2) * block_q
+
+    def body(j, acc):
+        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
+            jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
+            jnp.float32)
+        bias_blk = None
+        if has_bias:
+            bias_blk = bias_ref[0, 0, :, pl.ds(j * block_k,
+                                               block_k)].astype(
+                jnp.float32)
+        p, replaced = _recompute_p(q, kb, bias_blk, m, l, row0,
+                                   j * block_k, causal, t_k, block_q,
+                                   block_k)
+        dp = jax.lax.dot_general(g, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = jnp.where(replaced, 0.0, p * (dp - delta))
+        return acc + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    dq = jax.lax.fori_loop(0, t_k_pad // block_k, body, acc0)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_attention_bwd_dkv_kernel(q_ref, k_ref, v_ref, *rest,
+                                    causal: bool, has_bias: bool,
+                                    t_k: int, t_q_pad: int, block_q: int,
+                                    block_k: int):
+    """dK/dV for one (batch, head, k-block) grid cell: loop the query
+    blocks, recompute each P tile, accumulate ``dV = Σ_q Pᵀ · dO`` (the
+    FULL P — an all-masked row's uniform weights really do route
+    cotangent into V, matching the einsum VJP) and
+    ``dK = Σ_q dSᵀ · Q``."""
+    rest = list(rest)
+    bias_ref = rest.pop(0) if has_bias else None
+    g_ref, m_ref, l_ref, delta_ref, dk_ref, dv_ref = rest
+    kb = k_ref[0, 0].astype(jnp.float32)                   # (bk, d)
+    vb = v_ref[0, 0].astype(jnp.float32)
+    col0 = pl.program_id(2) * block_k
+    d = kb.shape[-1]
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32)
+        gb = g_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32)
+        mb = m_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        lb = l_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        db = delta_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        bias_blk = None
+        if has_bias:
+            bias_blk = bias_ref[0, 0, pl.ds(i * block_q, block_q),
+                                :].astype(jnp.float32)
+        p, replaced = _recompute_p(qb, kb, bias_blk, mb, lb, i * block_q,
+                                   col0, causal, t_k, block_q, block_k)
+        dv = dv + jax.lax.dot_general(
+            p, gb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bk, d)
+        dp = jax.lax.dot_general(gb, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = jnp.where(replaced, 0.0, p * (dp - db))
+        dk = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bk, d)
+        return dk, dv
+
+    z = jnp.zeros((kb.shape[0], d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, t_q_pad // block_q, body, (z, z))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
 def _reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                          bias: Optional[jnp.ndarray],
                          causal: bool) -> jnp.ndarray:
     """The einsum path on ``(B, H, T, D)`` layout — the semantics the
-    kernel must match, and the function whose VJP serves as the
-    kernel's backward pass (evaluated at the same inputs)."""
+    kernel (forward AND backward) must match; the parity tests compare
+    both the primal outputs and ``jax.grad`` through this function
+    against the kernels."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32)
     if bias is not None:
@@ -159,23 +325,17 @@ def _build(causal: bool, block_q: int, block_k: int, interpret: bool,
     (cached: ``jax.custom_vjp`` objects must be stable across traces so
     jit caches hit)."""
 
-    def forward(q, k, v, bias):
-        b, h, t_q, d = q.shape
-        t_k = k.shape[2]
-        # clamp tiles to the (sublane-rounded) token counts, then pad
-        # tokens to tile multiples; off-TPU interpret mode skips the
-        # lane pad (no hardware tiling to satisfy)
-        bq = min(block_q, _round_up(t_q, _SUBLANE))
-        bk = min(block_k, _round_up(t_k, _SUBLANE))
-        t_q_pad = _round_up(t_q, bq)
-        t_k_pad = _round_up(t_k, bk)
-        d_pad = d if interpret else _round_up(d, _LANE)
-
-        pad = lambda x, t: jnp.pad(
-            x, ((0, 0), (0, 0), (0, t - x.shape[2]),
-                (0, d_pad - x.shape[3])))
+    def _pad_args(q, k, v, bias, bq, bk, t_q_pad, t_k_pad, d_pad):
+        # no-op pads are SKIPPED, not emitted: unoptimized HLO charges a
+        # zero-width lax.pad as a full read+write of the tensor, which
+        # would bill the audit's cost ratchets for copies the optimizer
+        # deletes (interpret mode at exact tile sizes pads nothing)
+        def pad(x, t):
+            if t == x.shape[2] and d_pad == x.shape[3]:
+                return x
+            return jnp.pad(x, ((0, 0), (0, 0), (0, t - x.shape[2]),
+                               (0, d_pad - x.shape[3])))
         qp, kp, vp = pad(q, t_q_pad), pad(k, t_k_pad), pad(v, t_k_pad)
-
         in_specs = [
             pl.BlockSpec((1, 1, bq, d_pad), lambda b_, h_, i: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, t_k_pad, d_pad),
@@ -186,45 +346,153 @@ def _build(causal: bool, block_q: int, block_k: int, interpret: bool,
         args = [qp, kp, vp]
         if has_bias:
             h_b = bias.shape[1]             # 1 (broadcast) or H
-            bp = jnp.pad(bias, ((0, 0), (0, 0),
-                                (0, t_q_pad - bias.shape[2]),
-                                (0, t_k_pad - bias.shape[3])))
+            bp = bias
+            if (t_q_pad, t_k_pad) != bias.shape[2:]:
+                bp = jnp.pad(bias, ((0, 0), (0, 0),
+                                    (0, t_q_pad - bias.shape[2]),
+                                    (0, t_k_pad - bias.shape[3])))
             in_specs.append(pl.BlockSpec(
                 (1, 1, bq, t_k_pad),
                 lambda b_, h_, i, hb=h_b: (b_, h_ if hb > 1 else 0, i, 0)))
             args.append(bp)
+        return args, in_specs
+
+    def forward(q, k, v, bias, save_res: bool):
+        b, h, t_q, d = q.shape
+        t_k = k.shape[2]
+        bq, bk, t_q_pad, t_k_pad, d_pad = _tile_geometry(
+            t_q, t_k, d, block_q, block_k, interpret)
+        args, in_specs = _pad_args(q, k, v, bias, bq, bk, t_q_pad,
+                                   t_k_pad, d_pad)
 
         kernel = functools.partial(
             _flash_attention_kernel, causal=causal, has_bias=has_bias,
-            t_k=t_k, t_k_pad=t_k_pad, block_q=bq, block_k=bk)
+            save_res=save_res, t_k=t_k, t_k_pad=t_k_pad, block_q=bq,
+            block_k=bk)
+        out_shape = jax.ShapeDtypeStruct((b, h, t_q_pad, d_pad), q.dtype)
+        out_specs = pl.BlockSpec((1, 1, bq, d_pad),
+                                 lambda b_, h_, i: (b_, h_, i, 0))
+        # slice only if the output actually carries pad (cost-model
+        # cleanliness, like _pad_args)
+        unpad = (lambda o: o if (t_q_pad, d_pad) == (t_q, d)
+                 else o[:, :, :t_q, :d])
+        if save_res:
+            res_spec = pl.BlockSpec((1, 1, bq),
+                                    lambda b_, h_, i: (b_, h_, i))
+            res_shape = jax.ShapeDtypeStruct((b, h, t_q_pad), jnp.float32)
+            out, m, l = pl.pallas_call(
+                kernel,
+                grid=(b, h, t_q_pad // bq),
+                in_specs=in_specs,
+                out_specs=(out_specs, res_spec, res_spec),
+                out_shape=(out_shape, res_shape, res_shape),
+                interpret=interpret,
+            )(*args)
+            return unpad(out), m, l
         out = pl.pallas_call(
             kernel,
             grid=(b, h, t_q_pad // bq),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, 1, bq, d_pad),
-                                   lambda b_, h_, i: (b_, h_, i, 0)),
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(*args)
+        return unpad(out)
+
+    def backward(q, k, v, bias, o, m, l, g):
+        """Flash backward: ``Δ = rowsum(dO ∘ O)`` (one elementwise pass,
+        no score-shaped tensor), then two pallas programs — dQ gridded
+        over q-blocks, dK/dV over k-blocks — each recomputing P tiles in
+        VMEM from (q, k, residuals)."""
+        b, h, t_q, d = q.shape
+        t_k = k.shape[2]
+        bq, bk, t_q_pad, t_k_pad, d_pad = _tile_geometry(
+            t_q, t_k, d, block_q, block_k, interpret)
+        args, in_specs = _pad_args(q, k, v, bias, bq, bk, t_q_pad,
+                                   t_k_pad, d_pad)
+        delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1)                           # (b, h, t_q)
+        gp = g
+        if (t_q_pad, d_pad) != (t_q, d):
+            gp = jnp.pad(g, ((0, 0), (0, 0), (0, t_q_pad - t_q),
+                             (0, d_pad - d)))
+        dp_ = delta
+        if t_q_pad != t_q:
+            dp_ = jnp.pad(delta, ((0, 0), (0, 0), (0, t_q_pad - t_q)))
+        # m/l come back from the forward already t_q_pad-long
+        qd_spec = pl.BlockSpec((1, 1, bq, d_pad),
+                               lambda b_, h_, i: (b_, h_, i, 0))
+        qrow_spec = pl.BlockSpec((1, 1, bq), lambda b_, h_, i: (b_, h_, i))
+        qfull_spec = pl.BlockSpec((1, 1, t_q_pad, d_pad),
+                                  lambda b_, h_, j: (b_, h_, 0, 0))
+        qfullrow_spec = pl.BlockSpec((1, 1, t_q_pad),
+                                     lambda b_, h_, j: (b_, h_, 0))
+        kd_spec = pl.BlockSpec((1, 1, bk, d_pad),
+                               lambda b_, h_, j: (b_, h_, j, 0))
+
+        dq_kernel = functools.partial(
+            _flash_attention_bwd_dq_kernel, causal=causal,
+            has_bias=has_bias, t_k=t_k, t_k_pad=t_k_pad, block_q=bq,
+            block_k=bk)
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid=(b, h, t_q_pad // bq),
+            in_specs=in_specs + [qd_spec, qrow_spec, qrow_spec,
+                                 qrow_spec],
+            out_specs=qd_spec,
             out_shape=jax.ShapeDtypeStruct((b, h, t_q_pad, d_pad),
                                            q.dtype),
             interpret=interpret,
-        )(*args)
-        return out[:, :, :t_q, :d]
+        )(*args, gp, m, l, dp_)
+
+        # dK/dV grid over key blocks: Q/dO/residuals arrive whole, the
+        # key/value/bias specs re-map onto the k-block axis
+        in_specs_kv = [
+            qfull_spec,                                     # q (full)
+            kd_spec,                                        # k block
+            kd_spec,                                        # v block
+        ]
+        if has_bias:
+            h_b = bias.shape[1]
+            in_specs_kv.append(pl.BlockSpec(
+                (1, 1, t_q_pad, bk),
+                lambda b_, h_, j, hb=h_b: (b_, h_ if hb > 1 else 0, 0, j)))
+        dkv_kernel = functools.partial(
+            _flash_attention_bwd_dkv_kernel, causal=causal,
+            has_bias=has_bias, t_k=t_k, t_q_pad=t_q_pad, block_q=bq,
+            block_k=bk)
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            grid=(b, h, t_k_pad // bk),
+            in_specs=in_specs_kv + [qfull_spec, qfullrow_spec,
+                                    qfullrow_spec, qfullrow_spec],
+            out_specs=(kd_spec, kd_spec),
+            out_shape=(jax.ShapeDtypeStruct((b, h, t_k_pad, d_pad),
+                                            k.dtype),
+                       jax.ShapeDtypeStruct((b, h, t_k_pad, d_pad),
+                                            v.dtype)),
+            interpret=interpret,
+        )(*args, gp, m, l, dp_)
+        unpad_q = (lambda x: x if (t_q_pad, d_pad) == (t_q, d)
+                   else x[:, :, :t_q, :d])
+        unpad_k = (lambda x: x if (t_k_pad, d_pad) == (t_k, d)
+                   else x[:, :, :t_k, :d])
+        return unpad_q(dq), unpad_k(dk), unpad_k(dv)
 
     @jax.custom_vjp
     def attn(q, k, v, bias):
-        return forward(q, k, v, bias)
+        return forward(q, k, v, bias, save_res=False)
 
     def attn_fwd(q, k, v, bias):
-        return forward(q, k, v, bias), (q, k, v, bias)
+        o, m, l = forward(q, k, v, bias, save_res=True)
+        return o, (q, k, v, bias, o, m, l)
 
     def attn_bwd(res, g):
-        q, k, v, bias = res
-        # recompute-in-backward against the reference einsum math: exact
-        # gradients of the same function (up to float reassociation),
-        # no residual logits tensor kept from the forward
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: _reference_attention(q_, k_, v_, bias,
-                                                    causal), q, k, v)
-        dq, dk, dv = vjp(g)
+        q, k, v, bias, o, m, l = res
+        dq, dk, dv = backward(q, k, v, bias, o, m, l, g)
+        # the bias plane encodes the (non-differentiable) mask; its
+        # cotangent is structurally zero, as on the einsum path where
+        # the mask feeds only `where` predicates
         db = jnp.zeros_like(bias) if bias is not None else None
         return dq, dk, dv, db
 
@@ -246,7 +514,9 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ``mask``: optional ``(B, 1|H, T_q, T_k)``; zero entries are
     suppressed (module semantics). ``interpret=None`` auto-selects the
     Pallas interpreter off-TPU (CPU tier-1 gate); pass an explicit bool
-    to force either mode."""
+    to force either mode. Differentiating through the call runs the
+    flash backward kernels (P recomputed in VMEM from per-row
+    residuals — no logits/P tensor in HBM either direction)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     bias = None
@@ -269,9 +539,12 @@ def register_audit_programs(ctx):
     model shapes so each stays ratcheted and fingerprinted
     (``analysis/programs.json``) — a silent jaxpr change in either the
     einsum path or the pallas lowering fails the gate like every other
-    hot program. The pallas variant lowers the interpret form (the gate
-    is pinned to CPU); on-TPU it lowers to a Mosaic custom call with
-    the same kernel body."""
+    hot program. ``attn_pallas_bwd`` additionally lowers the GRADIENT
+    of the pallas module (value_and_grad over q/k inputs), pinning the
+    flash backward kernels — the train-path lowering PR 13 added — the
+    same way. The pallas variants lower the interpret form (the gate is
+    pinned to CPU); on-TPU they lower to Mosaic custom calls with the
+    same kernel bodies."""
     from ..analysis.registry import AuditProgram
     from ..models.transformer import MultiHeadAttention
 
@@ -279,7 +552,7 @@ def register_audit_programs(ctx):
     dt = jnp.dtype(m.dtype)
     b, t = 4, 8                         # tiny token grid, audit-scale
 
-    def make(impl, fn_name):
+    def parts(impl):
         mha = MultiHeadAttention(emb=m.emb, heads=m.heads,
                                  standard_heads=m.standard_heads,
                                  dtype=dt, attn_impl=impl)
@@ -288,6 +561,10 @@ def register_audit_programs(ctx):
         params = jax.eval_shape(lambda: mha.init(
             jax.random.PRNGKey(0), q0, k0))
         aval = jax.ShapeDtypeStruct((b, t, m.emb), dt)
+        return mha, params, aval
+
+    def make(impl, fn_name):
+        mha, params, aval = parts(impl)
 
         def apply(p, q, kk):
             return mha.apply(p, q, kk)
@@ -298,7 +575,24 @@ def register_audit_programs(ctx):
                         f"audit model shapes — both rollout-path "
                         f"attention lowerings stay fingerprinted")
 
+    def make_bwd():
+        mha, params, aval = parts("pallas")
+
+        def loss(p, q, kk):
+            return (mha.apply(p, q, kk).astype(jnp.float32) ** 2).sum()
+
+        grad = jax.value_and_grad(loss, argnums=(1, 2))
+        grad.__name__ = grad.__qualname__ = "_attn_pallas_bwd"
+        return AuditProgram(
+            jax.jit(grad), (params, aval, aval),
+            description="value_and_grad through the pallas "
+                        "MultiHeadAttention — the flash backward "
+                        "kernels (dq + dkv pallas programs, P "
+                        "recomputed in VMEM) stay fingerprinted and "
+                        "ratcheted alongside the forward")
+
     return {
         "attn_xla": make("xla", "_attn_xla"),
         "attn_pallas": make("pallas", "_attn_pallas"),
+        "attn_pallas_bwd": make_bwd(),
     }

@@ -23,6 +23,7 @@ bootstrap because ``terminated`` excludes the time-limit step (Q7).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -213,7 +214,13 @@ class QMixLearner:
             # scan (differentiable, loop-invariant)
             if self._agent_qslice:
                 agent_params = self._fold_params(agent_params)
-                fwd = self.mac.forward_qslice
+                # the learner unroll is where kernels.attention lands on
+                # the qslice path: under "pallas" the sliced attention
+                # (and, through jax.grad, its flash BACKWARD kernels)
+                # lowers into the train step at the train dtype; acting/
+                # serving callers keep the einsum default (basic_mac)
+                fwd = functools.partial(self.mac.forward_qslice,
+                                        attn_impl=self.cfg.kernels.attention)
             else:
                 fwd = self.mac.forward
 
@@ -233,7 +240,8 @@ class QMixLearner:
                     obs_t, k_t = xs
                     q, h = self.mac.forward_qslice(
                         agent_params, obs_t, h, key=k_t,
-                        deterministic=False)
+                        deterministic=False,
+                        attn_impl=self.cfg.kernels.attention)
                     return h, (q, h)
             else:
                 def body(h, xs):
@@ -508,15 +516,35 @@ def register_audit_programs(ctx):
     avals only (the replay sample's eval_shape); never executed."""
     import jax
 
-    from ..analysis.registry import AuditProgram
-    exp, ts, cfg = ctx.exp, ctx.ts_shape, ctx.cfg
-    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
-    batch, _, weights = jax.eval_shape(
-        lambda b, k, t: exp.buffer.sample(b, k, cfg.batch_size, t),
-        ts.buffer, key, ts.runner.t_env)
-    train = jax.jit(exp.learner.train)
-    return {"learner_train": AuditProgram(
-        train, (ts.learner, batch, weights, ts.runner.t_env, ts.episode,
-                key),
-        description="one importance-weighted QMIX update (loss + "
-                    "optimizer + target sync)")}
+    from ..analysis.registry import AuditProgram, kernels_audit_context
+
+    def entry(c, description):
+        exp, ts, cfg = c.exp, c.ts_shape, c.cfg
+        key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+        batch, _, weights = jax.eval_shape(
+            lambda b, k, t: exp.buffer.sample(b, k, cfg.batch_size, t),
+            ts.buffer, key, ts.runner.t_env)
+        train = jax.jit(exp.learner.train)
+        return AuditProgram(
+            train, (ts.learner, batch, weights, ts.runner.t_env,
+                    ts.episode, key),
+            description=description)
+
+    out = {"learner_train": entry(
+        ctx, "one importance-weighted QMIX update (loss + optimizer + "
+             "target sync)")}
+    # kernel-mode byte-comparison pair (PR 13): the bare learner update
+    # under each kernels.attention mode at the kernel audit scale —
+    # narrows the train_iter_pallas[_ref] comparison to the learner
+    # alone, so a bytes regression is attributable before it shows up in
+    # the composite program (lowered level; pallas pinned strictly below
+    # the _ref twin by tests/test_graftprog.py)
+    for mode, name in (("pallas", "learner_train_pallas"),
+                       ("xla", "learner_train_pallas_ref")):
+        out[name] = entry(
+            kernels_audit_context(mode),
+            f"one QMIX update under kernels.attention={mode} at the "
+            f"kernel audit scale — the flash-vs-einsum learner byte "
+            f"comparison (pallas must stay strictly below the _ref "
+            f"twin)")
+    return out

@@ -166,12 +166,27 @@ def fold_transformer(tf_params: dict, *, emb: int, heads: int,
 
 def transformer_rows(tf_folded: dict, k0: jnp.ndarray, x0: jnp.ndarray, *,
                      emb: int, heads: int, depth: int,
-                     dtype=jnp.float32) -> jnp.ndarray:
+                     dtype=jnp.float32, attn_impl: str = "xla"
+                     ) -> jnp.ndarray:
     """Carry ``R`` query rows through ``depth`` pre-folded blocks against
     the pinned layer-0 keys ``k0 (S, T, E)``. ``x0 (S, R, E)`` must be the
     slice of ``k0`` rows whose outputs are consumed (agent: row 0; mixer:
     the last ``n_agents+3`` rows). Returns the final rows ``(S, R, E)`` in
-    f32."""
+    f32.
+
+    ``attn_impl`` is the ``kernels.attention`` switch for THIS forward:
+    ``"pallas"`` routes the ``R·H`` sliced query rows through the flash
+    kernel (``kernels/attention.py``) as one head-free attention —
+    batch ``S``, query axis ``R·H``, the shared ``k0`` as both keys and
+    values — so neither the ``(S, R·H, T)`` logits tensor nor (under
+    ``jax.grad``) its backward recompute ever reach HBM. The learner
+    unrolls pass the config switch; acting/serving callers keep the
+    default (the rollout's per-step attention is Q=H rows — too small
+    for the tiling to pay — and the serving artifact's lowering must
+    never depend on a training-run perf knob). Numerics: the kernel
+    keeps f32 softmax statistics at every dtype, so the bf16 mode is
+    *better*-conditioned than the einsum branch below (which softmaxes
+    in bf16); f32 matches to reassociation (tests/test_kernels.py)."""
     s, r, _ = x0.shape
     for i in range(depth):
         bp = tf_folded["blocks"][i]
@@ -180,20 +195,30 @@ def transformer_rows(tf_folded: dict, k0: jnp.ndarray, x0: jnp.ndarray, *,
         qp = jnp.dot(x0.reshape(s * r, emb), wqk,
                      preferred_element_type=jnp.float32)
         qp = qp.astype(dtype).reshape(s, r * heads, emb)
-        logits = jax.lax.dot_general(
-            qp, k0, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)                 # (S, R·H, T)
-        # parity mode keeps f32 softmax; bf16 perf mode stays in bf16
-        # (mirrors models/transformer.py:101-105)
-        if dtype == jnp.float32:
-            attn = jax.nn.softmax(logits, axis=-1)
+        if attn_impl == "pallas":
+            # fused flash kernel over the R·H sliced rows: the folded
+            # wqk already carries the d**-0.5 logit scaling, k0 doubles
+            # as keys AND values (the qslice identity: ctx = attn·k0,
+            # wvu applies after), no mask/causal structure
+            from ..kernels.attention import flash_attention
+            ctx = flash_attention(qp[:, None], k0[:, None],
+                                  k0[:, None])[:, 0]        # (S, R·H, E)
+            ctx = ctx.astype(dtype).reshape(s * r, heads * emb)
         else:
-            attn = jax.nn.softmax(logits.astype(dtype), axis=-1)
-        attn = attn.astype(dtype)
-        ctx = jax.lax.dot_general(
-            attn, k0, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)                 # (S, R·H, E)
-        ctx = ctx.astype(dtype).reshape(s * r, heads * emb)
+            logits = jax.lax.dot_general(
+                qp, k0, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)             # (S, R·H, T)
+            # parity mode keeps f32 softmax; bf16 perf mode stays in bf16
+            # (mirrors models/transformer.py:101-105)
+            if dtype == jnp.float32:
+                attn = jax.nn.softmax(logits, axis=-1)
+            else:
+                attn = jax.nn.softmax(logits.astype(dtype), axis=-1)
+            attn = attn.astype(dtype)
+            ctx = jax.lax.dot_general(
+                attn, k0, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)             # (S, R·H, E)
+            ctx = ctx.astype(dtype).reshape(s * r, heads * emb)
         attended = (jnp.dot(ctx, wvu, preferred_element_type=jnp.float32)
                     + bp["u_bias"].astype(jnp.float32))         # (S·R, E) f32
         x0 = _block_tail(bp, attended,
@@ -275,12 +300,16 @@ def agent_forward_qslice(variables: dict, inputs: jnp.ndarray,
                          heads: int, depth: int, n_actions: int,
                          standard_heads: bool = False,
                          dtype=jnp.float32,
-                         noise_key: jnp.ndarray | None = None
+                         noise_key: jnp.ndarray | None = None,
+                         attn_impl: str = "xla"
                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Drop-in for ``TransformerAgent.apply`` (dropout=0; noisy heads
     supported via ``noise_key`` — see ``_q_head``):
     inputs ``(B, A, obs)``, hidden ``(B, A, emb)`` → (q, hidden').
-    Accepts either the raw flax variables or a ``fold_agent_params`` tree."""
+    Accepts either the raw flax variables or a ``fold_agent_params`` tree.
+    ``attn_impl`` selects the sliced-attention lowering (see
+    ``transformer_rows``; the learner unroll passes the config's
+    ``kernels.attention``)."""
     f = fold_agent_params(variables, emb=emb, heads=heads, depth=depth,
                           standard_heads=standard_heads, dtype=dtype)
     b, a, _ = inputs.shape
@@ -298,7 +327,7 @@ def agent_forward_qslice(variables: dict, inputs: jnp.ndarray,
 
     out = transformer_rows(f["tf"], k0, h0[:, None, :],
                            emb=emb, heads=heads, depth=depth,
-                           dtype=dtype)                         # (S, 1, E)
+                           dtype=dtype, attn_impl=attn_impl)    # (S, 1, E)
 
     h_new = out[:, 0, :]                                        # (S, E) f32
     q = _q_head(f["qb"], h_new, noise_key)
@@ -309,7 +338,10 @@ def agent_forward_qslice(variables: dict, inputs: jnp.ndarray,
 def make_mixer_qslice(mixer):
     """(fold_fn, apply_fn) pair closing over a ``TransformerMixer``'s
     attributes, so callers (the learner unroll) don't re-plumb the module
-    config. ``apply_fn`` matches ``mixer.apply``'s positional signature."""
+    config. ``apply_fn`` matches ``mixer.apply``'s positional signature.
+    The mixer's ``attn_impl`` (= the config's ``kernels.attention``)
+    threads through: this pair is consumed ONLY by the learner unroll,
+    so the kernel switch lands exactly on the train path."""
     fold = lambda variables: fold_mixer_params(
         variables, emb=mixer.emb, heads=mixer.heads, depth=mixer.depth,
         standard_heads=mixer.standard_heads, dtype=mixer.dtype)
@@ -317,6 +349,7 @@ def make_mixer_qslice(mixer):
         mp, qvals, h, hyper, s, o,
         n_agents=mixer.n_agents, n_entities=mixer.n_entities,
         feat_dim=mixer.feat_dim, emb=mixer.emb, heads=mixer.heads,
+        attn_impl=mixer.attn_impl,
         depth=mixer.depth, pos_func=mixer.qmix_pos_func,
         pos_func_beta=mixer.qmix_pos_func_beta,
         state_entity_mode=mixer.state_entity_mode,
@@ -453,7 +486,7 @@ def mixer_forward_qslice(variables: dict, qvals: jnp.ndarray,
                          pos_func: str, pos_func_beta: float,
                          state_entity_mode: bool = True,
                          standard_heads: bool = False,
-                         dtype=jnp.float32
+                         dtype=jnp.float32, attn_impl: str = "xla"
                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Drop-in for ``TransformerMixer.apply`` (dropout=0): only the last
     ``n_agents+3`` output rows are consumed (w1 per agent, b1, w2, the b2
@@ -485,7 +518,7 @@ def mixer_forward_qslice(variables: dict, qvals: jnp.ndarray,
     r = n_agents + 3
     out = transformer_rows(f["tf"], k0, k0[:, -r:, :],
                            emb=emb, heads=heads, depth=depth,
-                           dtype=dtype)                         # (b, A+3, E)
+                           dtype=dtype, attn_impl=attn_impl)    # (b, A+3, E)
 
     w1 = out[:, :n_agents, :]                                   # (b, A, emb)
     b1 = out[:, -3, :].reshape(b, 1, emb)

@@ -154,8 +154,10 @@ class Experiment:
         if not cfg.replay.buffer_cpu_only:
             buf_kw["compact_obs"] = entity_store_eligible(cfg)
         if cfg.replay.buffer_cpu_only:
-            # host-RAM replay with the native sum-tree (reference
-            # buffer_cpu_only semantics: storage on CPU, samples to device)
+            # host-RAM replay with the device-side PER sample (reference
+            # buffer_cpu_only semantics: storage on CPU, samples to
+            # device; the priority vector is device-mirrored so index
+            # selection + importance weights run as one device program)
             from .components.host_replay import HostReplayBuffer
             buffer = HostReplayBuffer(
                 alpha=cfg.replay.per_alpha, beta0=cfg.replay.per_beta,
@@ -236,14 +238,18 @@ class Experiment:
 
             def train_iter_host(ts: TrainState, key: jax.Array,
                                 t_env: jnp.ndarray):
-                # host RNG owns sampling; key seeds noise/dropout (train
-                # ignores it for pure configs). sample() first consumes
-                # the PREVIOUS iteration's deferred priority feedback —
-                # the |TD| / finite-flag fetch is started asynchronously
-                # below and never blocks this iteration (one ~0.66 s
-                # tunnel round-trip per train iter removed, BASELINE.md);
-                # the non-finite guard moves into the flush (a tripped
-                # step still leaves the sum-tree untouched)
+                # host RNG owns the stratum uniforms; key seeds noise/
+                # dropout (train ignores it for pure configs). sample()
+                # first consumes the PREVIOUS iteration's deferred
+                # priority feedback — the |TD| / finite-flag fetch is
+                # started asynchronously below and never blocks this
+                # iteration (one ~0.66 s tunnel round-trip per train
+                # iter removed, BASELINE.md); the non-finite guard
+                # stays in the flush (a tripped step leaves the
+                # priority mirrors untouched). Index selection and
+                # importance weights run as ONE device program over the
+                # mirrored priority vector (PR 13) — zero sum-tree
+                # ctypes crossings on this path
                 batch, idx, weights = buffer.sample(cfg.batch_size,
                                                     int(t_env))
                 learner_state, info = train(ts.learner, batch, weights,
@@ -416,7 +422,33 @@ def register_audit_programs(ctx):
             sup, (ts, keys, t_env), donate_argnums=(0,), compile=True,
             description=f"fused K={k} rollout->insert->train superstep "
                         f"(donated TrainState)"),
+        **_kernel_pair_programs(key, t_env),
     }
+
+
+def _kernel_pair_programs(key, t_env):
+    """The kernel-mode byte-comparison pair (PR 13): the SAME
+    ``_train_iter`` lowered under each ``kernels.attention`` mode at the
+    kernel audit scale (``registry.kernels_audit_config`` — token counts
+    where the logits tensor the flash path eliminates is material).
+    Lowered level only; the GP302 ratchet + tests/test_graftprog.py pin
+    ``train_iter_pallas`` strictly BELOW ``train_iter_pallas_ref`` —
+    the train-path bytes the flash backward exists to remove."""
+    from .analysis.registry import AuditProgram, kernels_audit_context
+    out = {}
+    for mode, name in (("pallas", "train_iter_pallas"),
+                       ("xla", "train_iter_pallas_ref")):
+        kctx = kernels_audit_context(mode)
+        _, _, k_train_iter = kctx.exp.jitted_programs(donate=True)
+        out[name] = AuditProgram(
+            k_train_iter, (kctx.ts_shape, key, t_env),
+            donate_argnums=(0,),
+            description=(f"sample -> train -> priority feedback under "
+                         f"kernels.attention={mode} at the kernel audit "
+                         f"scale — the flash-vs-einsum train-path byte "
+                         f"comparison (pallas must stay strictly below "
+                         f"the _ref twin)"))
+    return out
 
 
 def run(cfg: TrainConfig, logger: Optional[Logger] = None) -> TrainState:

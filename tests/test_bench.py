@@ -70,25 +70,35 @@ def test_acting_selector_reported(acting):
 
 @pytest.mark.slow   # subprocess + two fresh dense-rollout jits (xla + pallas
                     # interpret) — the --kernels A/B contract (docs/PERF.md)
-def test_kernels_ab_leg_one_record_per_mode():
-    """``--kernels ab``: one record per kernel mode, each carrying the
-    mode, the forced dense acting path, and its own per-mode span legs —
-    the attributable A/B the roofline report joins against."""
+def test_kernels_ab_leg_records_per_mode():
+    """``--kernels ab``: TWO records per kernel mode since PR 13 — the
+    dense rollout (env_steps_per_sec) and the train-step leg
+    (train_iters_per_sec, the flash-backward half of the A/B) — each
+    carrying the mode and its own per-mode span legs, schema'd via
+    ``_finalize``; the attributable A/B the roofline report joins
+    against."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, "bench.py", "--smoke", "--kernels", "ab",
          "--envs", "4", "--steps", "4"],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
     assert proc.returncode == 0, proc.stderr[-2000:]
     recs = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
-    assert [r["kernels"] for r in recs] == ["xla", "pallas"]
+    assert [(r["kernels"], r["metric"]) for r in recs] == [
+        ("xla", "env_steps_per_sec"), ("xla", "train_iters_per_sec"),
+        ("pallas", "env_steps_per_sec"), ("pallas", "train_iters_per_sec")]
     for rec in recs:
-        assert rec["metric"] == "env_steps_per_sec"
-        assert rec["acting"] == "dense"
         assert isinstance(rec["value"], (int, float)) and rec["value"] > 0
+        assert rec["schema"] == 1
         assert "bench.measure" in rec["spans"]
+        if rec["metric"] == "env_steps_per_sec":
+            assert rec["acting"] == "dense"
+        else:
+            assert rec["unit"] == "train-iters/s/chip"
+            assert rec["train_batch_episodes"] > 0
+            assert rec["leg"] == f"kernels-{rec['kernels']}-train"
 
 
 @pytest.mark.slow   # subprocess + fresh jit; rbg impl pinned cheaply in test_driver

@@ -160,6 +160,98 @@ def test_gp202_skips_pallas_kernel_block_specs():
     assert rep.rule_count("GP202") == 1
 
 
+def test_flash_backward_pallas_calls_stay_opaque():
+    """PR 13 backward kernels: differentiating through the flash
+    attention lowers the dq/dkv pallas programs — they must get the
+    SAME treatment as the forward kernel: never GP204 (a kernel launch
+    is not a host callback), block-spec/grid params and kernel-internal
+    f32 accumulator casts opaque to GP202/GP203. The only counted
+    upcasts are the caller's own seams (here: none — all-f32 toy), and
+    a genuine host constant NEXT TO the backward still trips GP202."""
+    from t2omca_tpu.kernels.attention import flash_attention
+
+    aval = jax.ShapeDtypeStruct((2, 2, 24, 8), jnp.float32)
+
+    def loss(q, k, v):
+        return (flash_attention(q, k, v, interpret=True,
+                                block_q=8, block_k=8) ** 2).sum()
+
+    grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    rep = _audit(grad, (aval, aval, aval), dtype="float32")
+    assert rep.rule_count("GP204") == 0
+    assert rep.rule_count("GP202") == 0
+    assert rep.rule_count("GP203") == 0
+
+    big = jnp.ones((256, 256), jnp.float32)
+
+    def loss_with_const(q, k, v):
+        dq, _, _ = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return (dq.reshape(-1, 8) @ big[:8, :8]).sum() + jnp.sum(big)
+
+    rep = _audit(jax.jit(loss_with_const), (aval, aval, aval),
+                 dtype="float32")
+    assert rep.rule_count("GP202") == 1          # the host const, only
+    assert rep.rule_count("GP204") == 0
+
+
+def test_flash_backward_is_pallas_not_einsum_recompute():
+    """The gradient of the flash kernel must run the flash BACKWARD
+    kernels (three pallas_calls: residual-emitting forward, dq, dkv) —
+    NOT the pre-PR-13 einsum-reference recompute, whose jaxpr had ONE
+    pallas_call and a (B, H, Q, K)-shaped softmax chain in the host
+    program."""
+    from jax.core import ClosedJaxpr
+    from t2omca_tpu.kernels.attention import flash_attention
+
+    x = jnp.zeros((2, 2, 24, 8), jnp.float32)
+
+    def loss(q, k, v):
+        return (flash_attention(q, k, v, interpret=True,
+                                block_q=8, block_k=8) ** 2).sum()
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(x, x, x)
+
+    def count_pallas(closed):
+        n = 0
+        stack = [closed]
+        seen = set()
+        while stack:
+            cj = stack.pop()
+            if id(cj) in seen:
+                continue
+            seen.add(id(cj))
+            for eqn in cj.jaxpr.eqns:
+                if "pallas" in eqn.primitive.name:
+                    n += 1
+                    continue            # opaque, like the auditor
+                for v in eqn.params.values():
+                    if isinstance(v, ClosedJaxpr):
+                        stack.append(v)
+                    elif isinstance(v, (tuple, list)):
+                        stack.extend(u for u in v
+                                     if isinstance(u, ClosedJaxpr))
+        return n
+
+    assert count_pallas(jaxpr) == 3
+
+
+def test_programs_json_pins_pallas_train_bytes_below_xla():
+    """The PR 13 acceptance relation, enforced against the checked-in
+    ratchet file (no jax, no lowering — the audit prelude keeps the
+    numbers honest): under ``kernels.attention: pallas`` the lowered
+    GP302 bytes AND GP301 flops of the train-path programs sit STRICTLY
+    below their einsum (_ref) twins at the kernel audit scale."""
+    data = json.loads(
+        (REPO / "t2omca_tpu/analysis/programs.json").read_text())
+    progs = data["programs"]
+    for name in ("train_iter_pallas", "learner_train_pallas"):
+        pal, ref = progs[name], progs[f"{name}_ref"]
+        assert pal["level"] == ref["level"] == "lowered"
+        assert pal["bytes_accessed"] < ref["bytes_accessed"], (
+            name, pal["bytes_accessed"], ref["bytes_accessed"])
+        assert pal["flops"] < ref["flops"]
+
+
 def test_clean_program_no_findings_and_metrics():
     def f(x):
         return x * 2.0
@@ -428,7 +520,9 @@ def test_registry_names_and_structure():
     reg = collect_default_programs()
     assert set(reg) == {"rollout", "insert", "train_iter", "superstep",
                         "dp_superstep", "learner_train", "serve_step",
-                        "attn_xla", "attn_pallas",
+                        "attn_xla", "attn_pallas", "attn_pallas_bwd",
+                        "train_iter_pallas", "train_iter_pallas_ref",
+                        "learner_train_pallas", "learner_train_pallas_ref",
                         "actor_step", "learner_step",
                         "env_reset", "env_step"}
     # the donated hot programs are the compiled (memory-audited) ones

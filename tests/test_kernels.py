@@ -105,26 +105,107 @@ def test_flash_fully_masked_row_matches_einsum_degenerate():
                                rtol=1e-5, atol=1e-5)
 
 
-def test_flash_gradients_match_einsum():
-    """The custom VJP (recompute-in-backward against the einsum math)
-    must yield the einsum path's gradients at the same inputs — the
-    learner's dense unroll trains straight through the kernel."""
-    rng = np.random.default_rng(5)
-    q, k, v = (_rand(rng, (2, 2, 7, 8)) for _ in range(3))
-    mask = jnp.asarray(rng.random((2, 1, 7, 7)) > 0.3)
+def _grad_pair(q, k, v, mask, causal, **kw):
+    """(flash grads, einsum-reference grads) for a sum-of-squares loss —
+    the flash side runs the PR 13 backward kernels (P recomputed in
+    VMEM from the saved m/l residuals), the reference side is
+    ``jax.grad`` through the einsum path."""
     bias = _mask_bias(mask)
 
     def loss_p(q, k, v):
-        return (flash_attention(q, k, v, mask=mask) ** 2).sum()
+        return (flash_attention(q, k, v, mask=mask, causal=causal,
+                                **kw).astype(jnp.float32) ** 2).sum()
 
     def loss_r(q, k, v):
-        return (_reference_attention(q, k, v, bias, False) ** 2).sum()
+        return (_reference_attention(
+            q, k, v, bias, causal).astype(jnp.float32) ** 2).sum()
 
-    gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
-    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    return (jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v),
+            jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("masked", [False, True])
+def test_flash_backward_matches_einsum_f32(causal, masked):
+    """The flash backward kernels must yield the einsum VJP's gradients
+    at the same inputs to float-reassociation scale — the learner
+    unrolls train straight through the kernel (mask-replacement and
+    causal cotangent-zeroing semantics identical)."""
+    rng = np.random.default_rng(5)
+    q, k, v = (_rand(rng, (2, 2, 7, 8)) for _ in range(3))
+    mask = jnp.asarray(rng.random((2, 1, 7, 7)) > 0.3) if masked else None
+    gp, gr = _grad_pair(q, k, v, mask, causal)
     for a, b in zip(gp, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_flash_backward_pad_tails_and_per_head_mask():
+    """Backward with explicit tiny blocks over non-dividing token counts
+    (t_q=5, t_k=7 at 4-blocks): the recomputed P tiles carry real pad
+    columns/rows whose cotangents must vanish exactly; the (B, H, ...)
+    per-head mask exercises the backward's head-indexed bias specs."""
+    rng = np.random.default_rng(6)
+    q = _rand(rng, (2, 2, 5, 12))
+    k = _rand(rng, (2, 2, 7, 12))
+    v = _rand(rng, (2, 2, 7, 12))
+    mask = jnp.asarray(rng.random((2, 2, 5, 7)) > 0.4)   # per-head
+    gp, gr = _grad_pair(q, k, v, mask, False, block_q=4, block_k=4)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_flash_backward_multi_k_block():
+    """Several key blocks per query block: the backward's inner loop
+    recomputes MULTIPLE P tiles against one residual pair — the case
+    where a fused-lse residual (m + log l) or a per-block renormalize
+    bug would surface."""
+    rng = np.random.default_rng(7)
+    q, k, v = (_rand(rng, (1, 2, 40, 8)) for _ in range(3))
+    gp, gr = _grad_pair(q, k, v, None, False, block_q=16, block_k=16)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_flash_backward_all_masked_row():
+    """All-masked rows: the forward degrades to uniform-over-keys, and
+    the einsum VJP still routes cotangent into V through those uniform
+    weights while zeroing dQ/dK (every logit was replaced). The m/l
+    residuals are kept SEPARATE precisely so the backward's recomputed
+    P survives this case in f32 (m = −1e9 swallows log l)."""
+    rng = np.random.default_rng(8)
+    q, k, v = (_rand(rng, (1, 1, 4, 8)) for _ in range(3))
+    mask = jnp.ones((1, 1, 4, 4), bool).at[0, 0, 2].set(False)
+    gp, gr = _grad_pair(q, k, v, mask, False)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+    # the masked row's uniform weights really do carry dV
+    assert float(jnp.abs(gp[2]).max()) > 0.0
+    # ... and its dq is exactly zero (all logits were replaced)
+    assert float(jnp.abs(np.asarray(gp[0])[0, 0, 2]).max()) == 0.0
+
+
+def test_flash_backward_bf16_within_tolerance():
+    """bf16 inputs: backward recompute + accumulation stay f32 inside
+    the kernels, so gradients sit within the established bf16 ULP
+    tolerance of the f32 einsum reference."""
+    rng = np.random.default_rng(9)
+    q, k, v = (_rand(rng, (2, 2, 17, 8), jnp.bfloat16) for _ in range(3))
+    gp, _ = _grad_pair(q, k, v, None, False)
+    gr32 = jax.grad(
+        lambda a, b, c: (_reference_attention(a, b, c, None, False)
+                         ** 2).sum(), argnums=(0, 1, 2))(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        v.astype(jnp.float32))
+    for a, b in zip(gp, gr32):
+        assert a.dtype == jnp.bfloat16
+        scale = float(jnp.abs(b).max())
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b), rtol=0.05,
+                                   atol=0.02 * max(scale, 1.0))
 
 
 # ------------------------------------------------- module-level switch
@@ -344,3 +425,145 @@ def test_dense_rollout_pallas_matches_xla():
     np.testing.assert_allclose(np.asarray(sx.episode_return),
                                np.asarray(sp.episode_return),
                                rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------- learner-unroll threading (PR 13)
+
+def test_transformer_rows_pallas_matches_xla_fwd_and_grad():
+    """The qslice sliced attention under attn_impl=pallas (one flash
+    call over the R·H query rows, k0 as keys AND values) must match the
+    einsum branch — forward and gradients — at f32: this is the exact
+    lowering the learner unrolls dispatch under kernels.attention:
+    pallas."""
+    from t2omca_tpu.models.transformer import Transformer
+    from t2omca_tpu.ops.query_slice import (fold_transformer,
+                                            transformer_rows)
+    rng = np.random.default_rng(10)
+    emb, heads, depth = 16, 2, 2
+    tf = Transformer(emb=emb, heads=heads, depth=depth)
+    k0 = _rand(rng, (3, 9, emb))
+    params = tf.init(jax.random.PRNGKey(0), k0, k0)
+
+    def rows(p, impl):
+        folded = fold_transformer(p["params"], emb=emb, heads=heads,
+                                  head_dim=emb, depth=depth,
+                                  dtype=jnp.float32)
+        out = transformer_rows(folded, k0, k0[:, -4:, :], emb=emb,
+                               heads=heads, depth=depth,
+                               attn_impl=impl)
+        return out
+
+    ox = rows(params, "xla")
+    op = rows(params, "pallas")
+    np.testing.assert_allclose(np.asarray(op), np.asarray(ox),
+                               rtol=1e-5, atol=1e-5)
+
+    gx = jax.grad(lambda p: (rows(p, "xla") ** 2).sum())(params)
+    gp = jax.grad(lambda p: (rows(p, "pallas") ** 2).sum())(params)
+    for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gx)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_acting_and_serving_ignore_pallas_mode(tiny_exp):
+    """The kernel switch must land ONLY on the learner unroll: the
+    qslice acting forward (select_actions path) and the default
+    forward_qslice (serving's serve_step calls it with no attn_impl)
+    stay bit-identical between kernel modes — the serving artifact's
+    lowering can never depend on a training-run perf knob."""
+    from t2omca_tpu.run import Experiment
+    exp32, ts, _ = tiny_exp
+    expp = Experiment.build(_tiny_cfg(kernels=KernelsConfig(
+        attention="pallas")))
+    p = ts.learner.params["agent"]
+    rng = np.random.default_rng(11)
+    obs = _rand(rng, (2, exp32.mac.n_agents, exp32.env.obs_dim))
+    hid = exp32.mac.init_hidden(2)
+    for acting in (True, False):
+        qx, _ = exp32.mac.forward_qslice(p, obs, hid, acting=acting)
+        qp, _ = expp.mac.forward_qslice(p, obs, hid, acting=acting)
+        assert (np.asarray(qx) == np.asarray(qp)).all()
+
+
+@pytest.mark.slow   # two Experiment builds + a train step each (~40 s)
+def test_qslice_train_step_pallas_matches_xla():
+    """End-to-end learner parity on the qslice path (the audit config's
+    shape): one train step under kernels.attention=pallas — agent AND
+    mixer unrolls lowering through the flash forward + backward kernels
+    — matches the einsum mode's loss exactly at f32 display precision
+    and its gradients/updated params to reassociation scale."""
+    from t2omca_tpu.run import Experiment
+    outs = {}
+    for mode in ("xla", "pallas"):
+        exp = Experiment.build(_tiny_cfg(
+            kernels=KernelsConfig(attention=mode)))
+        assert exp.mac.use_qslice
+        ts = exp.init_train_state(0)
+        _, batch, _ = exp.runner.run(ts.learner.params["agent"],
+                                     ts.runner)
+        small = jax.tree.map(lambda x: x[:2], batch)
+        ls, info = exp.learner.train(ts.learner, small, jnp.ones((2,)),
+                                     jnp.asarray(0), jnp.asarray(0))
+        outs[mode] = (ls, info)
+    ix, ip = outs["xla"][1], outs["pallas"][1]
+    np.testing.assert_allclose(float(ip["loss"]), float(ix["loss"]),
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(ip["grad_norm"]),
+                               float(ix["grad_norm"]), rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(outs["pallas"][0].params),
+                    jax.tree.leaves(outs["xla"][0].params)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.slow   # two dense Experiment builds + train compiles (~60 s)
+def test_dense_train_step_grads_pallas_matches_xla():
+    """E2E DENSE train-step grad parity (the ISSUE 13 pin): with the
+    qslice fast path off, the learner unroll runs MultiHeadAttention —
+    under pallas mode its custom VJP is now the flash backward, and one
+    full QMIX update (agent + mixer, online + target unrolls) must
+    reproduce the einsum mode's loss and gradient norm."""
+    from t2omca_tpu.run import Experiment
+    outs = {}
+    for mode in ("xla", "pallas"):
+        exp = Experiment.build(_tiny_cfg(
+            model={"use_qslice": False},
+            kernels=KernelsConfig(attention=mode)))
+        ts = exp.init_train_state(0)
+        _, batch, _ = exp.runner.run(ts.learner.params["agent"],
+                                     ts.runner)
+        small = jax.tree.map(lambda x: x[:2], batch)
+        _, info = exp.learner.train(ts.learner, small, jnp.ones((2,)),
+                                    jnp.asarray(0), jnp.asarray(0))
+        outs[mode] = info
+    np.testing.assert_allclose(float(outs["pallas"]["loss"]),
+                               float(outs["xla"]["loss"]), rtol=1e-6)
+    np.testing.assert_allclose(float(outs["pallas"]["grad_norm"]),
+                               float(outs["xla"]["grad_norm"]),
+                               rtol=1e-4)
+
+
+@pytest.mark.slow   # full pallas-mode superstep compile (~60 s)
+@pytest.mark.analysis
+def test_pallas_superstep_compile_budget():
+    """The pallas-mode fused superstep compiles exactly ONCE across
+    repeated dispatches — the flash kernels (forward-with-residuals +
+    the two backward programs, all behind lru-cached custom_vjp builds)
+    must not defeat jit caching with fresh callable identities per
+    trace."""
+    from t2omca_tpu.analysis import compile_budget
+    from t2omca_tpu.run import Experiment
+    cfg = _tiny_cfg(kernels=KernelsConfig(attention="pallas"),
+                    superstep=2)
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(0)
+    superstep = exp.superstep_program(2)
+    keys = jax.random.split(jax.random.PRNGKey(1), 2)
+    with compile_budget(1, match="_superstep") as log:
+        for i in range(3):
+            ts, stats, infos = superstep(ts, keys,
+                                         jnp.asarray(i * 16, jnp.int32))
+    assert log.count == 1
+    assert np.isfinite(
+        np.asarray(jax.device_get(stats.episode_return))).all()

@@ -565,6 +565,39 @@ def test_timeline_row_classification(tmp_path, capsys):
     assert rows["BENCH_r11"]["status"] == "unreadable"
 
 
+def test_timeline_parses_kernels_train_leg_record(tmp_path, capsys):
+    """PR 13 satellite: the new ``--kernels`` TRAIN-step record
+    (``train_iters_per_sec``, one per kernel mode, emitted via
+    ``_finalize``) renders as a measured timeline row with the kernel
+    mode in the note — and a torn copy of the same record (the tail a
+    killed daemon leg leaves) degrades to a no-record row instead of
+    raising, keeping the t1 timeline prelude green."""
+    from t2omca_tpu.obs.__main__ import main
+    rec = {"metric": "train_iters_per_sec", "value": 26.42,
+           "unit": "train-iters/s/chip", "vs_baseline": None,
+           "kernels": "pallas", "leg": "kernels-pallas-train",
+           "train_batch_episodes": 32, "config": 3,
+           "schema": 1, "platform": "tpu", "host": "h"}
+    (tmp_path / "BENCH_r08.json").write_text(json.dumps(rec))
+    # wrapper-with-tail shape (the daemon relay), torn mid-record
+    torn = json.dumps(rec)[: len(json.dumps(rec)) // 2]
+    (tmp_path / "BENCH_r09.json").write_text(json.dumps(
+        {"n": 9, "rc": 1, "parsed": None, "tail": "noise\n" + torn}))
+    rc = main(["timeline", *sorted(str(p) for p in
+                                   tmp_path.glob("BENCH_r*.json")),
+               "--json"])
+    assert rc == 0
+    rows = {r["name"]: r for r in
+            json.loads(capsys.readouterr().out)["rows"]}
+    row = rows["BENCH_r08"]
+    assert row["status"] == "measured"
+    assert row["metric"] == "train_iters_per_sec"
+    assert row["value"] == 26.42
+    assert "kernels=pallas" in row["note"]
+    assert "leg=kernels-pallas-train" in row["note"]
+    assert rows["BENCH_r09"]["status"] == "no-record"   # torn, not raised
+
+
 def test_timeline_run_rows_and_torn_metrics(tmp_path, capsys,
                                             monkeypatch):
     from t2omca_tpu.obs.__main__ import main
