@@ -25,6 +25,14 @@ bash scripts/lint.sh 2>&1 | tee /tmp/_t1_lint.log; lrc=${PIPESTATUS[0]}
 timeout -k 5 60 python -m t2omca_tpu.obs timeline BENCH_r*.json 2>&1 | tee /tmp/_t1_timeline.log; tlc=${PIPESTATUS[0]}
 [ $tlc -ne 0 ] && { echo "obs timeline smoke failed (exit $tlc; docs/OBSERVABILITY.md §pulse)"; exit 1; }
 grep -q "wedged" /tmp/_t1_timeline.log || { echo "obs timeline smoke: wedged BENCH rows missing from the table (docs/OBSERVABILITY.md §pulse)"; exit 1; }
+# Prelude 1c (obs learning, ~1 s, jax-free): the graftsight learning-
+# health CLI over the seeded fixture run dir must exit 0 and render the
+# health table + detector verdict — the post-mortem learning read must
+# not rot (docs/OBSERVABILITY.md §6).
+timeout -k 5 60 python -m t2omca_tpu.obs learning tests/fixtures_sight_run 2>&1 | tee /tmp/_t1_sight.log; slc=${PIPESTATUS[0]}
+[ $slc -ne 0 ] && { echo "obs learning smoke failed (exit $slc; docs/OBSERVABILITY.md §6)"; exit 1; }
+grep -q "learning health" /tmp/_t1_sight.log || { echo "obs learning smoke: health table missing (docs/OBSERVABILITY.md §6)"; exit 1; }
+grep -q "TRIPPED" /tmp/_t1_sight.log || { echo "obs learning smoke: seeded detector verdict missing (docs/OBSERVABILITY.md §6)"; exit 1; }
 # JAX_PLATFORMS pinned HERE, not just inside the CLI: the CLI's own pin
 # is a setdefault, and a preset JAX_PLATFORMS=tpu would otherwise make
 # the audit hit the platform-mismatch branch (warn + exit 0) — a silent

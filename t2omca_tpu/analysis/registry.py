@@ -201,6 +201,42 @@ def kernels_audit_config(attention: str = "xla"):
     ))
 
 
+def sight_audit_config():
+    """The frozen config for the graftsight-on twin entries
+    (``train_iter_sight``/``superstep_sight`` — run.py's
+    ``_sight_twin_programs``): ``audit_config`` with ONLY the static
+    ``obs.sight.enabled`` gate flipped, so the twin-vs-base budget
+    delta IS the in-graph diagnostic overhead and nothing else. Tiny
+    bins keep the histogram scatters audit-scale."""
+    import dataclasses as _dc
+
+    from ..config import SightConfig
+    cfg = audit_config()
+    return cfg.replace(obs=_dc.replace(
+        cfg.obs, sight=SightConfig(enabled=True, bins=8)))
+
+
+_sctx: Optional[AuditContext] = None
+
+
+def sight_audit_context() -> AuditContext:
+    """Build (once per process) the sight-on audit context — the
+    ``kernels_audit_context`` caching pattern."""
+    global _sctx
+    with _ctx_lock:
+        if _sctx is None:
+            import jax
+
+            from ..run import Experiment
+            cfg = sight_audit_config()
+            exp = Experiment.build(cfg)
+            ts_shape = jax.eval_shape(lambda: exp.init_train_state(
+                cfg.seed))
+            _sctx = AuditContext(cfg=cfg, exp=exp, ts_shape=ts_shape,
+                                 superstep_k=AUDIT_SUPERSTEP_K)
+        return _sctx
+
+
 _kctx: Dict[str, AuditContext] = {}
 
 

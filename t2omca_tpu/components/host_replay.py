@@ -354,6 +354,13 @@ class HostReplayBuffer:
                              self._storage)
         return batch, idx, w
 
+    def sight_priority_info(self) -> dict:
+        """graftsight PER health over the HOST priority mirror (pure
+        numpy — the buffer_cpu_only path pays zero device traffic for
+        the read; run.py's host train path appends it to train_info)."""
+        from ..obs.sight import buffer_sight_info_host
+        return buffer_sight_info_host(self._pri, self._count)
+
     def update_priorities(self, idx: np.ndarray,
                           priorities: np.ndarray) -> None:
         if not self.prioritized:

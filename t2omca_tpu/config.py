@@ -254,6 +254,55 @@ class ResilienceConfig:
 
 
 @dataclass(frozen=True)
+class SightConfig:
+    """graftsight learning-dynamics telemetry (``obs/sight.py``,
+    docs/OBSERVABILITY.md §6). ``enabled`` is a STATIC gate compiled
+    into the train step: off (the default) leaves every jitted program
+    byte-identical (graftprog fingerprints pinned); on, the train step
+    additionally reduces per-module gradient/update norms, fixed-bin
+    masked histograms, PER importance/priority health, per-layer
+    attention entropy and target drift ON DEVICE into ``train_info`` —
+    the diagnostics then ride the existing log-cadence fetch (zero
+    extra dispatches, zero extra device→host syncs). The host-side
+    ``SightMonitor`` runs the windowed detectors below over that
+    stream; each registers a pulse ``/healthz`` check when the live
+    plane is up (``pulse_port``) and a flight-recorder mark when span
+    telemetry is on (``enabled`` here does NOT require ``obs.enabled``
+    — the metrics.jsonl stream and the jax-free ``obs learning`` CLI
+    work standalone; the pulse/flight integrations simply no-op
+    without their planes)."""
+
+    enabled: bool = False
+    # fixed-bin masked histograms (TD error symmetric over ±td_range;
+    # q_taken/targets over ±q_range; outliers clip into the edge bins —
+    # an edge-bin pileup IS the signal the ranges exist to surface)
+    bins: int = 16
+    td_range: float = 10.0
+    q_range: float = 50.0
+    # detector window, in log cadences (plateau/starvation detectors
+    # need history; collapse/divergence detectors trip on one sample)
+    window: int = 5
+    # loss plateau: relative spread of the windowed loss below this
+    # fraction of its mean over a FULL window
+    plateau_rel: float = 0.02
+    # Q divergence: |q_taken_mean| or |target_mean| beyond this (raw
+    # value units — NaN-free blow-ups, the guard rail catches NaNs)
+    q_div: float = 1e4
+    # PER health: importance-weight effective sample size below this
+    # fraction of the batch, or priority-distribution entropy below
+    # this fraction of log(episodes_in_buffer) — the classic silent
+    # PER collapse (a handful of episodes soak all sampling mass)
+    ess_min: float = 0.05
+    priority_entropy_min: float = 0.1
+    # attention collapse: any layer's mean attention entropy below this
+    # fraction of log(n_keys) (0 = every head a delta function)
+    attn_entropy_min: float = 0.05
+    # per-module gradient starvation: a module's share of the total
+    # gradient norm below this for a FULL window
+    grad_starvation: float = 1e-6
+
+
+@dataclass(frozen=True)
 class ObsConfig:
     """graftscope runtime-telemetry knobs (docs/OBSERVABILITY.md). All
     host-side: nothing here touches the jitted programs, so the
@@ -305,6 +354,11 @@ class ObsConfig:
     # `enabled` (the snapshots ride the span/flight machinery — same
     # dead-knob policy as program_trace).
     memwatch: bool = False
+    # graftsight learning-dynamics telemetry (obs/sight.py): in-graph
+    # train-step diagnostics + host-side RL-health detectors. See
+    # SightConfig — deliberately NOT gated on `enabled` (its primary
+    # sink is the metrics.jsonl scalar stream, not the span plane).
+    sight: "SightConfig" = field(default_factory=lambda: SightConfig())
 
 
 @dataclass(frozen=True)
@@ -632,6 +686,32 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
             "artifacts — with obs.enabled=false none of those exist and "
             "the key is silently dead (same policy as program_trace); "
             "set obs.enabled=true too")
+    sg = o.sight
+    if sg.bins < 4:
+        raise ValueError(f"obs.sight.bins must be >= 4 (a histogram "
+                         f"needs bins to be one), got {sg.bins}")
+    if sg.td_range <= 0 or sg.q_range <= 0:
+        raise ValueError(
+            f"obs.sight.td_range/q_range must be > 0, got "
+            f"td_range={sg.td_range}, q_range={sg.q_range}")
+    if sg.window < 2:
+        raise ValueError(f"obs.sight.window must be >= 2 (plateau/"
+                         f"starvation detectors need history), got "
+                         f"{sg.window}")
+    if not 0.0 <= sg.ess_min <= 1.0:
+        raise ValueError(f"obs.sight.ess_min is a fraction of the batch "
+                         f"— must be in [0, 1], got {sg.ess_min}")
+    if not 0.0 <= sg.priority_entropy_min <= 1.0 \
+            or not 0.0 <= sg.attn_entropy_min <= 1.0:
+        raise ValueError(
+            f"obs.sight.priority_entropy_min/attn_entropy_min are "
+            f"fractions of the max entropy — must be in [0, 1], got "
+            f"{sg.priority_entropy_min}/{sg.attn_entropy_min}")
+    if sg.plateau_rel < 0 or sg.q_div <= 0 or sg.grad_starvation < 0:
+        raise ValueError(
+            f"obs.sight thresholds out of range: plateau_rel="
+            f"{sg.plateau_rel} (>= 0), q_div={sg.q_div} (> 0), "
+            f"grad_starvation={sg.grad_starvation} (>= 0)")
     sb = cfg.sebulba
     if (sb.actor_devices > 0) != (sb.learner_devices > 0):
         raise ValueError(
@@ -854,6 +934,18 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
         updates["resilience"] = dataclasses.replace(cfg.resilience,
                                                     **resilience_kw)
     if obs_kw:
+        # sight sub-tree: a nested dict (YAML), dotted keys (CLI
+        # `obs.sight.enabled=...` arrives here as "sight.enabled"), or
+        # an already-built SightConfig (from_dict re-entry) — the
+        # env_args.scenario pattern
+        sight_kw = obs_kw.pop("sight", None)
+        sight_kw = ({} if sight_kw is None
+                    else dataclasses.asdict(sight_kw)
+                    if isinstance(sight_kw, SightConfig) else dict(sight_kw))
+        for k in [k for k in obs_kw if k.startswith("sight.")]:
+            sight_kw[k.split(".", 1)[1]] = obs_kw.pop(k)
+        if sight_kw:
+            obs_kw["sight"] = dataclasses.replace(cfg.obs.sight, **sight_kw)
         updates["obs"] = dataclasses.replace(cfg.obs, **obs_kw)
     if kernels_kw:
         updates["kernels"] = dataclasses.replace(cfg.kernels, **kernels_kw)

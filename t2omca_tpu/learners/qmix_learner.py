@@ -413,6 +413,29 @@ class QMixLearner:
             # per-episode priorities (Q9): masked mean |TD| per sample
             "td_errors_abs": jnp.abs(td).sum(axis=0) / ep_mask,   # (B,)
         }
+        if cfg.obs.sight.enabled:
+            # graftsight in-graph diagnostics (docs/OBSERVABILITY.md §6):
+            # value-scale histograms + one-timestep attention-entropy
+            # probes, reduced on device into the info dict so they ride
+            # the log-cadence fetch. STATIC gate — off leaves this
+            # program byte-identical (graftprog fingerprints pinned);
+            # stop_gradient severs every probe from the backward pass.
+            from ..obs import sight as graftsight
+            sg = jax.lax.stop_gradient
+            info.update(graftsight.loss_sight_info(
+                cfg.obs.sight, sg(td), sg(chosen), sg(targets), mask))
+            if cfg.agent == "transformer":
+                info["sight_attn_entropy_agent"] = \
+                    graftsight.agent_attention_entropy(
+                        self, params["agent"],
+                        None if obs is None else obs[0],
+                        None if compact_tm is None
+                        else tuple(x[0] for x in compact_tm))
+            if cfg.mixer == "transformer":
+                info["sight_attn_entropy_mixer"] = \
+                    graftsight.mixer_attention_entropy(
+                        self, params["mixer"], state[0],
+                        None if obs is None else obs[0], sg(hs[0]))
         return loss, info
 
     # ------------------------------------------------------------------ train
@@ -425,12 +448,19 @@ class QMixLearner:
         sub-iterations never feed the driver's non-finite streak
         accounting."""
         z = jnp.zeros((), jnp.float32)
-        return {
+        out = {
             "loss": z, "td_error_abs": z, "q_taken_mean": z,
             "target_mean": z, "grad_norm": z,
             "td_errors_abs": jnp.zeros((batch_size,), jnp.float32),
             "all_finite": jnp.ones((), bool),
         }
+        if self.cfg.obs.sight.enabled:
+            # graftsight keys are part of the emitted pytree when the
+            # static gate is on — the skip branch must mirror them
+            # (aval-exact; the key set is a function of the CONFIG)
+            from ..obs import sight as graftsight
+            out.update(graftsight.train_info_extras_zeros(self.cfg))
+        return out
 
     def train(self, ls: LearnerState, batch: EpisodeBatch,
               weights: jnp.ndarray, t_env: jnp.ndarray,
@@ -488,6 +518,15 @@ class QMixLearner:
         opt_state = jax.tree.map(
             lambda n, o: jnp.where(all_finite, n, o), opt_state,
             ls.opt_state)
+        if self.cfg.obs.sight.enabled:
+            # graftsight learner-tail block: per-module grad/update
+            # norms, importance-weight ESS, target drift — computed
+            # AFTER the guard select so a tripped step reports the
+            # surviving (unchanged) params' drift, not the poisoned ones
+            from ..obs import sight as graftsight
+            info.update(graftsight.learner_train_info(
+                self.cfg, grads, updates, params, ls.target_params,
+                weights))
 
         episode = jnp.asarray(episode, jnp.int32)
         sync = (episode - ls.last_target_update

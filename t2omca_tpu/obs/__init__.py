@@ -46,6 +46,11 @@ _LAZY = {
     "MemWatch": "memwatch",
     "make_memwatch": "memwatch",
     "timeline_main": "timeline",
+    # graftsight learning-dynamics telemetry (stdlib+numpy at import;
+    # the in-graph helpers pull jax lazily inside their bodies)
+    "SightMonitor": "sight",
+    "make_monitor": "sight",
+    "learning_main": "sight",
 }
 
 __all__ = ["KNOWN_PHASES", "NULL_RECORDER", "NullRecorder",

@@ -18,7 +18,15 @@ Subcommands:
     distinguishing measured numbers from wedged partials
     (docs/OBSERVABILITY.md §pulse).
 
-Both are deliberately jax-free — the post-mortem host may not be able
+``learning <run_dir>``
+    The graftsight learning-health report (docs/OBSERVABILITY.md §6):
+    per-module gradient norms, PER health, attention entropies, value
+    histograms, detector verdicts and per-scenario-slice learning
+    curves from the run's ``metrics.jsonl`` (tolerant reader — torn
+    tails from killed runs are skipped with a warning). Answers "is
+    this run learning?" post-mortem.
+
+All are deliberately jax-free — the post-mortem host may not be able
 to initialize a backend at all.
 """
 
@@ -59,7 +67,17 @@ def main(argv=None) -> int:
                          "joins the table (newest env-steps/s)")
     tl.add_argument("--json", action="store_true",
                     help="machine-readable rows instead of the table")
+    ln = sub.add_parser(
+        "learning", help="graftsight learning-health report for a "
+                         "recorded run (docs/OBSERVABILITY.md §6)")
+    ln.add_argument("run_dir",
+                    help="results directory of a run (holds "
+                         "metrics.jsonl; obs.sight.enabled adds the "
+                         "learning-dynamics keys)")
     args = parser.parse_args(argv)
+    if args.cmd == "learning":
+        from .sight import learning_main
+        return learning_main(args.run_dir)
     if args.cmd == "report":
         from .report import report_main
         return report_main(args.run_dir, args.programs_json,

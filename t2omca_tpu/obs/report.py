@@ -520,4 +520,18 @@ def report_main(run_dir: str, programs_json: Optional[str] = None,
                       base["programs"], run_header(events))
     print(render(run_dir, events, rows, phases, run_header(events),
                  peak_gflops, peak_gbps))
+    # graftsight section: a run recorded with obs.sight.enabled carries
+    # learning-dynamics keys in metrics.jsonl — append the learning-
+    # health read so one `obs report` answers both "where did the time
+    # go" and "was it learning" (full detail: `obs learning <run_dir>`)
+    from .sight import _series_from_metrics, render_learning
+    mpath = os.path.join(run_dir, "metrics.jsonl")
+    try:
+        mevents = read_jsonl_tolerant(mpath, on_bad=_warn_torn(mpath))
+    except OSError:
+        mevents = []
+    series = _series_from_metrics(mevents)
+    if any(k.startswith("sight_") for k in series):
+        print()
+        print("\n".join(render_learning(run_dir, series)))
     return 0

@@ -107,6 +107,11 @@ KNOWN_PHASES = frozenset({
     # laddered backend-init probe and one A/B matrix leg subprocess)
     "pulse.scrape", "memwatch.snapshot", "trace.trigger",
     "bench.daemon.probe", "bench.daemon.leg",
+    # graftsight (obs/sight.py): the host-side RL-health detector pass
+    # over the log-cadence fetched train info — host-only (no device
+    # traffic), spanned so a slow sink/detector shows up in the phase
+    # tables instead of silently inflating the log cadence
+    "sight.detect",
 })
 
 _NOOP = contextlib.nullcontext()

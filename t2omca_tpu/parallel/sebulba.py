@@ -366,6 +366,11 @@ class Sebulba:
             buf = buffer.update_priorities(
                 ls.buffer, idx, info["td_errors_abs"] + 1e-6,      # Q9
                 valid=info["all_finite"])
+            # graftsight PER health (run._train_iter's in-graph read,
+            # re-homed with the rest of this program — the one shared
+            # definition keeps the emitted pytrees in sync)
+            from ..obs import sight as graftsight
+            info = graftsight.maybe_buffer_info(cfg, info, buf)
             ls = ls.replace(learner=learner_state, buffer=buf)
             return _strong(jax.tree.map(wsc, ls, ls_c(ls))), info
 

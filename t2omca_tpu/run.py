@@ -39,6 +39,7 @@ from .runners.episode_runner import EpisodeRunner
 from .runners.parallel_runner import ParallelRunner, RunnerState
 from .obs import memwatch as obs_memwatch
 from .obs import pulse as obs_pulse
+from .obs import sight as obs_sight
 from .obs import spans as obs_spans
 from .utils import resilience, watchdog
 from .utils.checkpoint import (find_checkpoint, load_checkpoint,
@@ -256,6 +257,11 @@ class Experiment:
                                             t_env, ts.episode, key)
                 buffer.defer_priority_update(idx, info["td_errors_abs"],
                                              info["all_finite"])
+                if cfg.obs.sight.enabled and buffer.prioritized:
+                    # host-replay twin of the in-graph PER health read:
+                    # pure numpy over the host priority mirror — zero
+                    # device traffic on the buffer_cpu_only path
+                    info = dict(info, **buffer.sight_priority_info())
                 return ts.replace(learner=learner_state), info
 
             return rollout, insert, train_iter_host
@@ -282,6 +288,11 @@ class Experiment:
             buf = buffer.update_priorities(
                 ts.buffer, idx, info["td_errors_abs"] + 1e-6,      # Q9
                 valid=info["all_finite"])
+            # graftsight PER health: one masked reduce over the
+            # post-update priority vector, inside this same program
+            # (docs/OBSERVABILITY.md §6 — zero extra dispatches;
+            # no-op unless the static gate + prioritized replay apply)
+            info = obs_sight.maybe_buffer_info(cfg, info, buf)
             return _strong(ts.replace(learner=c_learner(learner_state),
                                       buffer=c_buffer(buf))), info
 
@@ -356,11 +367,19 @@ class Experiment:
                 ts.buffer, idx, info["td_errors_abs"] + 1e-6,      # Q9
                 valid=info["all_finite"])
             return ts.replace(learner=c_learner(learner_state),
-                              buffer=c_buffer(buf)), info
+                              buffer=c_buffer(buf)), _sight_buf(info, buf)
+
+        def _sight_buf(info, buf):
+            # graftsight PER health, in-graph (the shared definition —
+            # see _train_iter). BOTH cond branches route through this
+            # so the info pytrees stay aval-identical (the skip branch
+            # reads the untouched ring)
+            return obs_sight.maybe_buffer_info(cfg, info, buf)
 
         def _skip(op):
             ts, _, _ = op
-            return ts, learner.train_info_zeros(cfg.batch_size)
+            return ts, _sight_buf(learner.train_info_zeros(cfg.batch_size),
+                                  ts.buffer)
 
         def _body(ts: TrainState, xs):
             key, t_env = xs
@@ -423,6 +442,7 @@ def register_audit_programs(ctx):
             description=f"fused K={k} rollout->insert->train superstep "
                         f"(donated TrainState)"),
         **_kernel_pair_programs(key, t_env),
+        **_sight_twin_programs(key, t_env),
     }
 
 
@@ -449,6 +469,39 @@ def _kernel_pair_programs(key, t_env):
                          f"comparison (pallas must stay strictly below "
                          f"the _ref twin)"))
     return out
+
+
+def _sight_twin_programs(key, t_env):
+    """The sight-on twin audit entries (the PR 13 kernel-pair pattern):
+    the SAME ``_train_iter``/``_superstep`` lowered under
+    ``obs.sight.enabled`` at the shared audit scale
+    (``registry.sight_audit_config``). The twins carry their own
+    GP301/302 budgets so the diagnostic overhead is itself RATCHETED —
+    a sight change that doubles the train step's bytes fails the gate —
+    while the sight-OFF fingerprints of
+    ``train_iter``/``superstep``/``learner_train``/``dp_superstep``
+    stay byte-identical (the static gate compiles out; zero
+    re-baseline, pinned by tests/test_sight.py)."""
+    from .analysis.registry import AuditProgram, sight_audit_context
+    sctx = sight_audit_context()
+    exp, ts, k = sctx.exp, sctx.ts_shape, sctx.superstep_k
+    _, _, s_train_iter = exp.jitted_programs(donate=True)
+    s_sup = exp.superstep_program(k, donate=True)
+    keys = jax.ShapeDtypeStruct((k,) + key.shape, key.dtype)
+    return {
+        "train_iter_sight": AuditProgram(
+            s_train_iter, (ts, key, t_env), donate_argnums=(0,),
+            description="sample -> train -> priority feedback with the "
+                        "graftsight in-graph diagnostics compiled in "
+                        "(obs.sight.enabled) — the diagnostic overhead "
+                        "ratchet next to the sight-off train_iter"),
+        "superstep_sight": AuditProgram(
+            s_sup, (ts, keys, t_env), donate_argnums=(0,),
+            description=f"fused K={k} superstep with the graftsight "
+                        f"diagnostics compiled in — pins the fused-path "
+                        f"diagnostic overhead (both lax.cond branches "
+                        f"carry the sight info pytree)"),
+    }
 
 
 def run(cfg: TrainConfig, logger: Optional[Logger] = None) -> TrainState:
@@ -513,12 +566,22 @@ def run_sequential(exp: Experiment, logger: Logger,
                hub=pulse.hub if pulse is not None else None,
                n_iterations=cfg.profile_iterations)
            if (rec.enabled or pulse is not None) else None)
+    # graftsight learning-health monitor (docs/OBSERVABILITY.md §6):
+    # None when obs.sight is off — the loop below is byte-identical.
+    # The in-graph half already rode the train programs; this is the
+    # host detector pass over the log-cadence fetch.
+    sight_mon = obs_sight.make_monitor(cfg.obs, logger=logger, rec=rec)
 
     def _persist_flight(path: str) -> None:
-        """Flight persist + the memwatch high-water block (cached state
-        only — safe on crash/stall paths over a wedged backend)."""
-        rec.persist(path, extra=({"memwatch": mw.report()}
-                                 if mw.enabled else None))
+        """Flight persist + the memwatch high-water + sight-verdict
+        blocks (cached state only — safe on crash/stall paths over a
+        wedged backend)."""
+        extra = {}
+        if mw.enabled:
+            extra["memwatch"] = mw.report()
+        if sight_mon is not None:
+            extra["sight"] = sight_mon.report()
+        rec.persist(path, extra=extra or None)
 
     # ---- data parallelism (SURVEY.md §7.2(6)) --------------------------
     # dp_devices > 0 swaps in the mesh-sharded program triple; the loop
@@ -648,6 +711,11 @@ def run_sequential(exp: Experiment, logger: Logger,
             # cached high-water only (report(), never snapshot()): the
             # stall path must not read the wedged backend it diagnoses
             extra["memwatch"] = mw.report()
+        if sight_mon is not None:
+            # learning-health verdicts fold into the diagnosis (host-
+            # cached like the memwatch block — a stalled run whose PER
+            # had already collapsed should say so post-mortem)
+            extra["sight"] = sight_mon.report()
         watchdog.write_diagnosis(diag, model_dir, extra=extra or None)
         # trip the guard BEFORE the save attempt: the emergency save
         # below reads device state over the possibly-wedged backend and
@@ -712,6 +780,11 @@ def run_sequential(exp: Experiment, logger: Logger,
         pulse.wire_guard(guard)
         pulse.set("superstep_k", K)
         pulse.set("backend_info", 1, backend=jax.default_backend())
+        if sight_mon is not None:
+            # one /healthz check per RL-health detector: the endpoint
+            # flips 503 naming the verdict (sight-<detector>) the
+            # moment the host pass trips it
+            sight_mon.wire_pulse(pulse.hub)
 
     def _watched(phase, state=None, **meta):
         """One watchdog stamp + graftscope span for a device-facing
@@ -1308,6 +1381,24 @@ def run_sequential(exp: Experiment, logger: Logger,
                     for k in ("loss", "grad_norm", "td_error_abs",
                               "q_taken_mean", "target_mean"):
                         logger.log_stat(k, float(last[k]), t_env)
+                    if sight_mon is not None:
+                        # graftsight detector pass over the SAME fetched
+                        # info (no extra device traffic; the monitor
+                        # logs the sight_* stats at full fidelity). A
+                        # fresh trip persists the flight ring like a
+                        # non-finite trip does — the post-mortem then
+                        # carries the verdict even if the run dies later
+                        with rec.span("sight.detect", t_env=t_env):
+                            trips = sight_mon.observe(last, t_env)
+                        if trips:
+                            log.warning(
+                                f"graftsight: detector(s) tripped at "
+                                f"t_env={t_env}: {', '.join(trips)} — "
+                                f"/healthz degraded; run `python -m "
+                                f"t2omca_tpu.obs learning "
+                                f"{results_dir}` for the read")
+                            _persist_flight(os.path.join(
+                                results_dir, "flight_recorder.json"))
                     train_infos = []
                     if (res.nonfinite_tolerance
                             and nonfinite_streak >= res.nonfinite_tolerance):
@@ -1532,9 +1623,17 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                n_iterations=cfg.profile_iterations)
            if (rec.enabled or pulse is not None) else None)
 
+    # graftsight monitor (learner-thread cadence pass; same off-state
+    # contract as the classic loop)
+    sight_mon = obs_sight.make_monitor(cfg.obs, logger=logger, rec=rec)
+
     def _persist_flight(path: str) -> None:
-        rec.persist(path, extra=({"memwatch": mw.report()}
-                                 if mw.enabled else None))
+        extra = {}
+        if mw.enabled:
+            extra["memwatch"] = mw.report()
+        if sight_mon is not None:
+            extra["sight"] = sight_mon.report()
+        rec.persist(path, extra=extra or None)
     from .parallel.sebulba import make_sebulba
     seb = make_sebulba(exp)
     lockstep = sb.queue_slots == 1 and sb.staleness == 0
@@ -1611,6 +1710,8 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                 log.exception("graftscope: flight tail unavailable")
         if mw.enabled:
             extra["memwatch"] = mw.report()     # cached, no device reads
+        if sight_mon is not None:
+            extra["sight"] = sight_mon.report()
         watchdog.write_diagnosis(diag, model_dir, extra=extra or None)
         guard.request("watchdog")
         with cond:
@@ -1648,6 +1749,8 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                 pass
         if mw.enabled:
             extra["memwatch"] = mw.report()
+        if sight_mon is not None:
+            extra["sight"] = sight_mon.report()
         watchdog.write_diagnosis(diag, model_dir, extra=extra or None)
         guard.request("watchdog-actor")
         with cond:
@@ -1675,6 +1778,8 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
         pulse.set("backend_info", 1, backend=jax.default_backend())
         pulse.set("queue_slots", sb.queue_slots)
         pulse.set("staleness_bound", sb.staleness)
+        if sight_mon is not None:
+            sight_mon.wire_pulse(pulse.hub)
 
     # ---- watched-dispatch helpers (both threads) ----------------------
     def _watched(phase, state=None, awd=None, t=0, **meta):
@@ -2079,6 +2184,18 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                 for k in ("loss", "grad_norm", "td_error_abs",
                           "q_taken_mean", "target_mean"):
                     logger.log_stat(k, float(last[k]), t_env)
+                if sight_mon is not None:
+                    # classic-loop contract: detector pass on the same
+                    # fetch, flight persist on a fresh trip
+                    with rec.span("sight.detect", t_env=t_env):
+                        trips = sight_mon.observe(last, t_env)
+                    if trips:
+                        log.warning(
+                            f"graftsight: detector(s) tripped at "
+                            f"t_env={t_env}: {', '.join(trips)} — "
+                            f"/healthz degraded")
+                        _persist_flight(os.path.join(
+                            results_dir, "flight_recorder.json"))
                 train_infos = []
                 if (res.nonfinite_tolerance
                         and nonfinite_streak >= res.nonfinite_tolerance):

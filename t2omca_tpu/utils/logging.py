@@ -76,7 +76,25 @@ class Logger:
 
     # ---- scalar API ------------------------------------------------------
     def log_stat(self, key: str, value, t: int) -> None:
-        value = float(value)
+        """Log one stat. Scalars are the contract; a VECTOR value (the
+        graftsight fixed-bin histograms, per-layer attention entropies)
+        degrades gracefully instead of crashing the diagnostics layer:
+        ``metrics.jsonl`` keeps the full-fidelity list, while the
+        in-memory history (the ``print_recent_stats`` console path) and
+        TensorBoard get the mean as a scalar summary."""
+        vector = None
+        nd = getattr(value, "ndim", None)
+        if isinstance(value, (list, tuple)) or (nd is not None and nd > 0):
+            import numpy as _np
+            arr = _np.asarray(value, dtype=_np.float64).reshape(-1)
+            vector = [float(v) for v in arr]
+            # console/TB summary: the mean (NaN-safe — a poisoned bin
+            # must not blank the whole console line). Size-1 vectors
+            # stay vectors deliberately: a (1,)-shaped stat is schema,
+            # not a scalar that happens to be boxed.
+            value = float(_np.nanmean(arr)) if arr.size else 0.0
+        else:
+            value = float(value)
         with self._lock:
             hist = self.stats[key]
             hist.append((t, value))
@@ -94,7 +112,9 @@ class Logger:
                 self._tb.add_scalar(key, value, t)
             if self._jsonl is not None:
                 self._jsonl.write(json.dumps(
-                    {"key": key, "value": value, "t": t}) + "\n")
+                    {"key": key,
+                     "value": value if vector is None else vector,
+                     "t": t}) + "\n")
                 self._jsonl.flush()
 
     def print_recent_stats(self) -> None:

@@ -524,7 +524,8 @@ def test_registry_names_and_structure():
                         "train_iter_pallas", "train_iter_pallas_ref",
                         "learner_train_pallas", "learner_train_pallas_ref",
                         "actor_step", "learner_step",
-                        "env_reset", "env_step"}
+                        "env_reset", "env_step",
+                        "train_iter_sight", "superstep_sight"}
     # the donated hot programs are the compiled (memory-audited) ones
     assert reg["superstep"].compile and reg["train_iter"].compile
     assert reg["superstep"].donate_argnums == (0,)

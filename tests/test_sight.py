@@ -1,0 +1,683 @@
+"""graftsight: in-graph learning-dynamics telemetry + RL-health
+detectors (t2omca_tpu/obs/sight.py, docs/OBSERVABILITY.md §6).
+
+Fast: config surface, histogram/entropy math, module grouping, the
+learner's sight keys + bit-parity with sight off, train_info_zeros
+aval mirror, Logger vector degrade, SightMonitor detector units, the
+jax-free learning CLI (+ torn-tail regression), programs.json twins.
+
+Slow: the K>1 classic driver path and the sebulba lockstep path with
+vector-valued train_info keys end-to-end, the injected-pathology
+acceptance (detector trips within one log cadence → /healthz 503 +
+flight mark + post-mortem CLI verdict), the zero-extra-transfer /
+one-compile pins, and the sight-off fingerprint pin.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from t2omca_tpu.config import (EnvConfig, ModelConfig, ObsConfig,
+                               ReplayConfig, ResilienceConfig, SightConfig,
+                               TrainConfig, from_dict, load_config,
+                               sanity_check)
+from t2omca_tpu.obs import sight
+from t2omca_tpu.obs.spans import KNOWN_PHASES, SpanRecorder
+from t2omca_tpu.utils.logging import Logger
+
+pytestmark = pytest.mark.sight
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_RUN = os.path.join(REPO, "tests", "fixtures_sight_run")
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+def test_sight_config_default_off_and_roundtrip():
+    cfg = TrainConfig()
+    assert cfg.obs.sight.enabled is False
+    cfg2 = from_dict({"obs": {"sight": {"enabled": True, "bins": 8}}})
+    assert cfg2.obs.sight.enabled and cfg2.obs.sight.bins == 8
+    # dotted CLI override routes through the nested block
+    cfg3 = load_config(overrides=("obs.sight.enabled=true",
+                                  "obs.sight.q_div=100.0"))
+    assert cfg3.obs.sight.enabled and cfg3.obs.sight.q_div == 100.0
+    # asdict → from_dict is the serve meta.json round trip
+    import dataclasses
+    cfg4 = from_dict(dataclasses.asdict(cfg2))
+    assert cfg4.obs.sight == cfg2.obs.sight
+
+
+def test_sight_config_sanity_rejects():
+    def bad(**kw):
+        return TrainConfig(obs=ObsConfig(sight=SightConfig(**kw)))
+    with pytest.raises(ValueError, match="bins"):
+        sanity_check(bad(bins=2))
+    with pytest.raises(ValueError, match="window"):
+        sanity_check(bad(window=1))
+    with pytest.raises(ValueError, match="ess_min"):
+        sanity_check(bad(ess_min=2.0))
+    with pytest.raises(ValueError, match="td_range"):
+        sanity_check(bad(td_range=0.0))
+    with pytest.raises(ValueError, match="q_div"):
+        sanity_check(bad(q_div=0.0))
+    # valid block passes
+    sanity_check(bad(enabled=True))
+
+
+def test_module_group_names_static():
+    assert sight.module_group_names(TrainConfig()) == ("agent_tf",
+                                                      "embed", "mixer")
+    assert sight.module_group_names(
+        TrainConfig(agent="rnn", mixer="vdn")) == ("embed",)
+    assert sight.module_group_names(
+        TrainConfig(mixer="vdn")) == ("agent_tf", "embed")
+
+
+# ---------------------------------------------------------------------------
+# in-graph math units
+# ---------------------------------------------------------------------------
+
+def test_masked_histogram_matches_numpy_and_clips():
+    x = jnp.asarray([-100.0, -0.5, 0.1, 0.4, 0.9, 100.0])
+    m = jnp.asarray([1.0, 1.0, 1.0, 1.0, 0.0, 1.0])
+    h = np.asarray(sight.masked_histogram(x, m, -1.0, 1.0, 4))
+    # masked value (0.9) excluded; ±100 clip into the edge bins;
+    # edges [-1,-.5,0,.5,1]: -0.5 sits at the bin-1 left edge
+    assert h.sum() == pytest.approx(1.0)
+    assert h[0] == pytest.approx(1 / 5)      # -100 (clipped)
+    assert h[1] == pytest.approx(1 / 5)      # -0.5
+    assert h[2] == pytest.approx(2 / 5)      # 0.1 and 0.4
+    assert h[3] == pytest.approx(1 / 5)      # +100 (clipped)
+
+
+def test_buffer_sight_info_host_entropy_extremes():
+    uniform = sight.buffer_sight_info_host(np.ones(64, np.float32), 64)
+    assert float(uniform["sight_priority_entropy_norm"]) \
+        == pytest.approx(1.0, abs=1e-5)
+    delta = np.zeros(64, np.float32)
+    delta[3] = 1.0
+    collapsed = sight.buffer_sight_info_host(delta, 64)
+    assert float(collapsed["sight_priority_entropy_norm"]) \
+        == pytest.approx(0.0, abs=1e-5)
+    empty = sight.buffer_sight_info_host(np.zeros(8, np.float32), 0)
+    assert float(empty["sight_priority_entropy"]) == 0.0
+
+
+def test_buffer_sight_info_device_matches_host():
+    pri = np.asarray([0.5, 0.25, 0.125, 0.125, 7.0, 9.0], np.float32)
+    dev = jax.device_get(sight.buffer_sight_info(
+        jnp.asarray(pri), jnp.asarray(4)))
+    host = sight.buffer_sight_info_host(pri, 4)
+    assert float(dev["sight_priority_entropy"]) == pytest.approx(
+        float(host["sight_priority_entropy"]), rel=1e-5)
+    assert float(dev["sight_priority_entropy_norm"]) == pytest.approx(
+        float(host["sight_priority_entropy_norm"]), rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# learner integration (tiny Experiment, one train step)
+# ---------------------------------------------------------------------------
+
+def _tiny_cfg(**kw):
+    obs_kw = kw.pop("obs_kw", {})
+    defaults = dict(
+        batch_size_run=2, batch_size=4, save_model=False,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=6),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1),
+        replay=ReplayConfig(buffer_size=8),
+        obs=ObsConfig(sight=SightConfig(enabled=True, bins=8,
+                                        **obs_kw.pop("sight_kw", {})),
+                      **obs_kw),
+    )
+    defaults.update(kw)
+    return sanity_check(TrainConfig(**defaults))
+
+
+def _one_train(cfg):
+    """Fill the tiny ring and run ONE train_iter; returns (ts2, info)."""
+    from t2omca_tpu.run import Experiment
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(0)
+    rollout, insert, train_iter = exp.jitted_programs()
+    for _ in range(2):
+        rs, batch, _ = rollout(ts.learner.params["agent"], ts.runner,
+                               test_mode=False)
+        ts = ts.replace(runner=rs, buffer=insert(ts.buffer, batch),
+                        episode=ts.episode + cfg.batch_size_run)
+    return exp, ts, train_iter(ts, jax.random.PRNGKey(7),
+                               jnp.asarray(100))
+
+
+def test_sight_keys_present_and_training_bit_identical():
+    """The tentpole parity contract: sight ON adds the diagnostic keys
+    but leaves the trained params (and the base info keys) BIT-identical
+    to sight OFF — the diagnostics are read-only passengers."""
+    cfg_on = _tiny_cfg()
+    cfg_off = cfg_on.replace(obs=ObsConfig())
+    _, _, (ts_on, info_on) = _one_train(cfg_on)
+    _, _, (ts_off, info_off) = _one_train(cfg_off)
+
+    sight_keys = {k for k in info_on if k.startswith("sight_")}
+    assert {"sight_grad_norm_agent_tf", "sight_grad_norm_embed",
+            "sight_grad_norm_mixer", "sight_update_norm_mixer",
+            "sight_per_ess", "sight_target_drift", "sight_td_hist",
+            "sight_q_taken_hist", "sight_target_hist",
+            "sight_attn_entropy_agent", "sight_attn_entropy_mixer",
+            "sight_priority_entropy", "sight_priority_entropy_norm"
+            } <= sight_keys
+    assert not any(k.startswith("sight_") for k in info_off)
+
+    info_on = jax.device_get(info_on)
+    assert info_on["sight_td_hist"].shape == (8,)
+    assert info_on["sight_td_hist"].sum() == pytest.approx(1.0, abs=1e-5)
+    assert info_on["sight_attn_entropy_agent"].shape == (1,)
+    assert 0.0 <= float(info_on["sight_attn_entropy_agent"][0]) <= 1.0 + 1e-5
+    assert 0.0 < float(info_on["sight_per_ess"]) <= 1.0 + 1e-5
+    assert np.isfinite(info_on["sight_target_drift"])
+
+    # params bit-identical; base info keys bit-identical
+    for a, b in zip(jax.tree.leaves(ts_on.learner.params),
+                    jax.tree.leaves(ts_off.learner.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    off = jax.device_get(info_off)
+    for k in off:
+        np.testing.assert_array_equal(np.asarray(info_on[k]),
+                                      np.asarray(off[k]))
+
+
+def test_train_info_zeros_mirrors_sight_avals():
+    """The superstep lax.cond requires both branches to return one
+    pytree: train_info_zeros must mirror train's sight keys aval-exact
+    (shape, dtype, weak-type via the astype strip)."""
+    cfg = _tiny_cfg()
+    exp, ts, (_, info) = _one_train(cfg)
+    zeros = exp.learner.train_info_zeros(cfg.batch_size)
+    # the priority-entropy keys are appended by the driver programs in
+    # BOTH cond branches (run.py _sight_buf), not by the learner —
+    # everything else must mirror exactly
+    assert set(zeros) == {k for k in info
+                          if not k.startswith("sight_priority_entropy")}
+    assert "sight_priority_entropy" not in zeros
+    for k in zeros:
+        za, ia = (np.asarray(jax.device_get(zeros[k])),
+                  np.asarray(jax.device_get(info[k])))
+        assert za.shape == ia.shape and za.dtype == ia.dtype, k
+
+
+def test_attention_entropy_uniform_when_logits_zero():
+    """Zeroed query projections ⇒ all attention logits 0 ⇒ uniform
+    distribution ⇒ normalized entropy exactly 1 — pins the probe's
+    normalization AND its layer plumbing."""
+    cfg = _tiny_cfg()
+    from t2omca_tpu.run import Experiment
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(0)
+    params = ts.learner.params["agent"]
+    zeroed = jax.tree_util.tree_map_with_path(
+        lambda path, x: (jnp.zeros_like(x)
+                         if any(getattr(p, "key", None) == "toqueries"
+                                for p in path) else x), params)
+    b, a = 2, cfg.env_args.agv_num
+    obs_t0 = jnp.asarray(
+        np.random.default_rng(0).normal(size=(b, a, exp.learner.obs_dim)),
+        jnp.float32)
+    ents = jax.device_get(sight.agent_attention_entropy(
+        exp.learner, zeroed, obs_t0, None))
+    assert ents.shape == (cfg.model.depth,)
+    assert float(ents[0]) == pytest.approx(1.0, abs=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Logger vector degrade (satellite: non-scalar stats)
+# ---------------------------------------------------------------------------
+
+def test_logger_vector_stat_degrades_to_summary(tmp_path):
+    logger = Logger()
+    logger.setup_json(str(tmp_path))
+    hist = np.asarray([0.0, 0.25, 0.5, 0.25], np.float32)
+    logger.log_stat("sight_td_hist", hist, 100)     # must not raise
+    logger.log_stat("loss", 1.5, 100)
+    logger.print_recent_stats()                     # console path survives
+    logger.close()
+    lines = [json.loads(l) for l in
+             open(os.path.join(str(tmp_path), "metrics.jsonl"))]
+    by_key = {l["key"]: l["value"] for l in lines}
+    # metrics.jsonl keeps FULL fidelity; the in-memory history (console
+    # path) holds the scalar summary (the mean)
+    assert by_key["sight_td_hist"] == pytest.approx(list(map(float, hist)))
+    assert by_key["loss"] == 1.5
+    assert logger.stats["sight_td_hist"][-1][1] == pytest.approx(
+        float(hist.mean()))
+
+
+def test_logger_scalar_path_unchanged(tmp_path):
+    logger = Logger()
+    logger.setup_json(str(tmp_path))
+    logger.log_stat("x", 2, 1)
+    logger.log_stat("x", jnp.asarray(3.0), 2)       # 0-d array stays scalar
+    logger.close()
+    lines = [json.loads(l) for l in
+             open(os.path.join(str(tmp_path), "metrics.jsonl"))]
+    assert [l["value"] for l in lines] == [2.0, 3.0]
+
+
+# ---------------------------------------------------------------------------
+# SightMonitor detector units
+# ---------------------------------------------------------------------------
+
+def _mk_monitor(tmp_path=None, rec=None, **kw):
+    cfg = SightConfig(enabled=True, window=3, **kw)
+    logger = Logger()
+    if tmp_path is not None:
+        logger.setup_json(str(tmp_path))
+    return sight.SightMonitor(cfg, logger=logger, rec=rec), logger
+
+
+def _healthy(t):
+    return {
+        "loss": 10.0 / (t + 1), "q_taken_mean": -5.0, "target_mean": -5.2,
+        "grad_norm": 1.0,
+        "sight_grad_norm_agent_tf": 0.5, "sight_grad_norm_embed": 0.2,
+        "sight_grad_norm_mixer": 0.4, "sight_per_ess": 0.8,
+        "sight_priority_entropy_norm": 0.9,
+        "sight_attn_entropy_agent": np.asarray([0.7]),
+        "sight_attn_entropy_mixer": np.asarray([0.5]),
+    }
+
+
+def test_monitor_healthy_stream_stays_green():
+    mon, _ = _mk_monitor()
+    for i in range(5):
+        assert mon.observe(_healthy(i), i * 100) == []
+    assert all(v["ok"] for v in mon.status.values())
+    assert mon.trips_total == 0
+
+
+def test_monitor_priority_collapse_trips_on_one_observation(tmp_path):
+    rec = SpanRecorder(ring_size=16)
+    mon, logger = _mk_monitor(tmp_path, rec=rec)
+    bad = dict(_healthy(0), sight_priority_entropy_norm=0.01)
+    trips = mon.observe(bad, 500)
+    assert trips == ["priority_collapse"]
+    assert not mon.status["priority_collapse"]["ok"]
+    assert "entropy" in mon.status["priority_collapse"]["detail"]
+    # recovery transitions back and logs 0 (no duplicate trip)
+    assert mon.observe(_healthy(1), 600) == []
+    assert mon.status["priority_collapse"]["ok"]
+    assert mon.trips_total == 1
+    # alert logged (trip AND clear transitions) + flight mark emitted
+    logger.close()
+    lines = [json.loads(l) for l in
+             open(os.path.join(str(tmp_path), "metrics.jsonl"))]
+    assert {"key": "sight_alert_priority_collapse", "value": 1.0,
+            "t": 500} in lines
+    assert {"key": "sight_alert_priority_collapse", "value": 0.0,
+            "t": 600} in lines
+    marks = [e for e in rec.tail() if e.get("event") == "mark"]
+    assert any(m.get("kind") == "sight"
+               and m.get("detector") == "priority_collapse" for m in marks)
+
+
+def test_monitor_q_divergence_and_ess():
+    mon, _ = _mk_monitor(q_div=100.0)
+    assert mon.observe(dict(_healthy(0), q_taken_mean=5e3), 1) \
+        == ["q_divergence"]
+    mon2, _ = _mk_monitor(ess_min=0.5)
+    assert mon2.observe(dict(_healthy(0), sight_per_ess=0.1), 1) \
+        == ["priority_collapse"]
+    assert "ESS" in mon2.status["priority_collapse"]["detail"]
+
+
+def test_monitor_attention_collapse_names_layer():
+    mon, _ = _mk_monitor(attn_entropy_min=0.2)
+    bad = dict(_healthy(0),
+               sight_attn_entropy_mixer=np.asarray([0.6, 0.01]))
+    assert mon.observe(bad, 1) == ["attention_collapse"]
+    assert "mixer layer 1" in mon.status["attention_collapse"]["detail"]
+
+
+def test_monitor_windowed_plateau_and_starvation():
+    mon, _ = _mk_monitor(plateau_rel=0.05, grad_starvation=1e-3)
+    flat = dict(_healthy(0), loss=1.0, sight_grad_norm_embed=1e-7)
+    # needs a FULL window (3): no trip on the first two observations
+    assert mon.observe(dict(flat), 1) == []
+    assert mon.observe(dict(flat), 2) == []
+    trips = mon.observe(dict(flat), 3)
+    assert set(trips) == {"loss_plateau", "grad_starvation"}
+    assert "embed" in mon.status["grad_starvation"]["detail"]
+
+
+def test_monitor_total_gradient_death_trips_starvation():
+    """Complete gradient death (every module's norm exactly 0) must
+    trip grad_starvation after a full window — the strictly-worse case
+    must not read as 'warming up' forever (review-pass fix)."""
+    mon, _ = _mk_monitor(grad_starvation=1e-3)
+    dead = dict(_healthy(0), sight_grad_norm_agent_tf=0.0,
+                sight_grad_norm_embed=0.0, sight_grad_norm_mixer=0.0)
+    assert mon.observe(dict(dead), 1) == []
+    assert mon.observe(dict(dead), 2) == []
+    assert "grad_starvation" in mon.observe(dict(dead), 3)
+
+
+def test_spark_survives_poisoned_cells():
+    """The post-mortem renderer must survive (and show) NaN/Inf cells —
+    the Logger keeps poisoned bins at full fidelity on purpose, and
+    pathological runs are exactly the CLI's use case (review-pass
+    fix)."""
+    assert sight._spark([0.1, float("nan"), 0.5]) == ".!@"
+    assert "!" in sight._spark([float("inf"), 1.0])
+    assert sight._spark([float("nan")]) == "-"
+    assert sight._spark([]) == "-"
+    # a NaN loss mid-series must not kill the health-table trend either
+    lines = sight.render_learning(
+        "x", {"loss": [(0, 1.0), (1, float("nan")), (2, 0.5)],
+              "sight_td_hist": [(2, [0.5, float("nan"), 0.5])]})
+    assert any("loss" in l for l in lines)
+
+
+def test_monitor_healthz_wiring_flips_endpoint():
+    from t2omca_tpu.obs.pulse import MetricsHub
+    hub = MetricsHub()
+    mon, _ = _mk_monitor()
+    mon.wire_pulse(hub)
+    ok, payload = hub.healthz()
+    assert ok and all(c["ok"] for c in payload["checks"].values())
+    mon.observe(dict(_healthy(0), sight_priority_entropy_norm=0.0), 10)
+    ok, payload = hub.healthz()
+    assert not ok
+    assert payload["status"] == "degraded"
+    assert not payload["checks"]["sight-priority_collapse"]["ok"]
+    # report() carries the verdicts for the stall-diagnosis extra
+    rep = mon.report()
+    assert rep["trips_total"] == 1
+    assert not rep["detectors"]["priority_collapse"]["ok"]
+
+
+# ---------------------------------------------------------------------------
+# learning CLI (jax-free; tolerant reader)
+# ---------------------------------------------------------------------------
+
+def test_learning_cli_renders_fixture_and_is_jax_free():
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; from t2omca_tpu.obs.__main__ import main; "
+         f"rc = main(['learning', {FIXTURE_RUN!r}]); "
+         "assert 'jax' not in sys.modules, 'learning CLI imports jax'; "
+         "sys.exit(rc)"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    assert "learning health" in out
+    assert "TRIPPED" in out                  # the seeded alert renders
+    assert "hetfleet" in out                 # per-slice learning curves
+    assert "verdict:" in out
+    assert "PER priority entropy" in out
+
+
+def test_learning_cli_torn_tail_regression(tmp_path):
+    """A killed run's torn final metrics line must warn + render, never
+    crash (the PR 12 torn-tail contract, extended to the learning CLI)."""
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    shutil.copy(os.path.join(FIXTURE_RUN, "metrics.jsonl"),
+                run_dir / "metrics.jsonl")
+    with open(run_dir / "metrics.jsonl", "a") as f:
+        f.write('{"key": "loss", "value": 0.1')     # torn mid-write
+    proc = subprocess.run(
+        [sys.executable, "-m", "t2omca_tpu.obs", "learning",
+         str(run_dir)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "torn final line" in proc.stderr
+    assert "learning health" in proc.stdout
+
+
+def test_learning_cli_usage_errors(tmp_path):
+    assert sight.learning_main(str(tmp_path / "nope")) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert sight.learning_main(str(empty)) == 2
+
+
+def test_sight_detect_phase_registered():
+    assert "sight.detect" in KNOWN_PHASES
+
+
+def test_programs_json_carries_justified_sight_twins():
+    with open(os.path.join(REPO, "t2omca_tpu", "analysis",
+                           "programs.json")) as f:
+        programs = json.load(f)["programs"]
+    for name in ("train_iter_sight", "superstep_sight"):
+        entry = programs[name]
+        assert "TODO" not in entry["justification"]
+        assert entry["flops"] > 0 and entry["bytes_accessed"] > 0
+        gp203 = entry["rules"]["GP203"]
+        assert gp203["count"] > 0 and "TODO" not in gp203["justification"]
+
+
+# ---------------------------------------------------------------------------
+# slow: driver paths, acceptance, pins
+# ---------------------------------------------------------------------------
+
+def _driver_cfg(tmp_path, port=0, **kw):
+    obs_kw = kw.pop("obs_kw", {})
+    sight_kw = kw.pop("sight_kw", {})
+    res_kw = kw.pop("res_kw", {})
+    defaults = dict(
+        t_max=120, batch_size_run=2, batch_size=4,
+        test_interval=1_000_000, test_nepisode=2, log_interval=12,
+        runner_log_interval=1_000_000, save_model=False,
+        local_results_path=str(tmp_path), use_tensorboard=False,
+        epsilon_anneal_time=50,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=6),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1),
+        replay=ReplayConfig(buffer_size=8),
+        resilience=ResilienceConfig(stall_grace_s=0.0, **res_kw),
+        obs=ObsConfig(enabled=True, flush_every=1, pulse_port=port,
+                      sight=SightConfig(enabled=True, bins=8, **sight_kw),
+                      **obs_kw),
+    )
+    defaults.update(kw)
+    return sanity_check(TrainConfig(**defaults))
+
+
+def _run_dir(tmp_path):
+    return [d for d in glob.glob(os.path.join(str(tmp_path), "*"))
+            if os.path.isdir(d) and os.path.basename(d) != "models"][0]
+
+
+def _metric_series(run_dir):
+    series = {}
+    with open(os.path.join(run_dir, "metrics.jsonl")) as f:
+        for line in f:
+            ev = json.loads(line)
+            series.setdefault(ev["key"], []).append(ev["value"])
+    return series
+
+
+@pytest.mark.slow
+def test_superstep_driver_logs_vector_sight_stats(tmp_path):
+    """Satellite: the classic K>1 path — (K, bins) stacked histograms
+    flow through the driver's per-row extraction and the Logger without
+    corrupting scalar keys; metrics.jsonl carries full-fidelity
+    vectors."""
+    from t2omca_tpu.run import run
+    cfg = _driver_cfg(tmp_path, superstep=4)
+    run(cfg, Logger())
+    series = _metric_series(_run_dir(tmp_path))
+    hists = series["sight_td_hist"]
+    assert hists and all(isinstance(h, list) and len(h) == 8
+                         for h in hists)
+    assert all(isinstance(v, float) for v in series["loss"])
+    assert all(isinstance(v, float)
+               for v in series["sight_priority_entropy_norm"])
+    ents = series["sight_attn_entropy_agent"]
+    assert ents and all(isinstance(e, list) and len(e) == 1 for e in ents)
+
+
+@pytest.mark.slow
+def test_sebulba_lockstep_logs_sight_stats(tmp_path):
+    """Satellite: the sebulba lockstep path emits the same sight keys
+    (the re-homed learner_step carries the in-graph block)."""
+    from t2omca_tpu.config import SebulbaConfig
+    from t2omca_tpu.run import run
+    cfg = _driver_cfg(
+        tmp_path,
+        sebulba=SebulbaConfig(actor_devices=1, learner_devices=1,
+                              queue_slots=1, staleness=0))
+    run(cfg, Logger())
+    series = _metric_series(_run_dir(tmp_path))
+    assert series.get("sight_td_hist")
+    assert all(len(h) == 8 for h in series["sight_td_hist"])
+    assert series.get("sight_priority_entropy_norm")
+
+
+def _get(url, timeout=1.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.getcode(), r.read().decode()
+
+
+class _HealthPoller(threading.Thread):
+    def __init__(self, port):
+        super().__init__(daemon=True)
+        self.url = f"http://127.0.0.1:{port}/healthz"
+        self.seen = []
+        self.stop = threading.Event()
+
+    def run(self):
+        while not self.stop.is_set():
+            try:
+                self.seen.append(_get(self.url))
+            except urllib.error.HTTPError as e:
+                self.seen.append((e.code, e.read().decode()))
+            except Exception:
+                pass
+            time.sleep(0.05)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_injected_pathology_trips_detector_within_one_cadence(tmp_path):
+    """Acceptance: a forced NaN-free Q blow-up (q_div threshold below
+    any real Q scale) trips sight-q_divergence at the FIRST log cadence
+    — /healthz returns 503 naming the check, flight_recorder.json
+    carries the sight mark + verdict block, and the jax-free
+    `obs learning` CLI renders the TRIPPED verdict post-mortem."""
+    from t2omca_tpu.run import run
+    port = _free_port()
+    cfg = _driver_cfg(tmp_path, port=port,
+                      sight_kw=dict(q_div=1e-9))
+    poller = _HealthPoller(port)
+    poller.start()
+    try:
+        run(cfg, Logger())
+    finally:
+        poller.stop.set()
+        poller.join(timeout=5)
+    # live: 503 naming the detector
+    degraded = [(code, body) for code, body in poller.seen if code == 503]
+    assert degraded, "healthz never degraded during the run"
+    payload = json.loads(degraded[-1][1])
+    assert not payload["checks"]["sight-q_divergence"]["ok"]
+    run_dir = _run_dir(tmp_path)
+    # the trip persisted the flight ring with the sight mark + verdicts
+    with open(os.path.join(run_dir, "flight_recorder.json")) as f:
+        flight = json.load(f)
+    assert any(e.get("kind") == "sight"
+               and e.get("detector") == "q_divergence"
+               for e in flight["events"])
+    assert not flight["sight"]["detectors"]["q_divergence"]["ok"]
+    # the trip landed within ONE log cadence of the first train info
+    series = _metric_series(run_dir)
+    assert series["sight_alert_q_divergence"][0] == 1.0
+    # post-mortem: the jax-free CLI renders the verdict
+    proc = subprocess.run(
+        [sys.executable, "-m", "t2omca_tpu.obs", "learning", run_dir],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "q_divergence" in proc.stdout and "TRIPPED" in proc.stdout
+    # sight.detect span landed and is registered
+    events = [json.loads(l)
+              for l in open(os.path.join(run_dir, "spans.jsonl"))
+              if l.strip()]
+    phases = {e.get("phase") for e in events if e.get("event") == "span"}
+    assert "sight.detect" in phases
+    assert phases <= KNOWN_PHASES, phases - KNOWN_PHASES
+
+
+@pytest.mark.slow
+@pytest.mark.analysis
+def test_sight_superstep_one_compile_and_no_transfers(tmp_path):
+    """Acceptance pin: sight on adds ZERO extra dispatches/transfers —
+    the K>1 superstep still compiles exactly ONCE and a warm dispatch
+    runs clean under the transfer guard (no hidden device_get from the
+    diagnostics)."""
+    from t2omca_tpu.analysis.guards import compile_budget, no_transfer
+    from t2omca_tpu.run import Experiment
+    cfg = _tiny_cfg(superstep=4)
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(0)
+    sup = exp.superstep_program(4)
+    # t_envs precomputed OUTSIDE the guard: the guarded dispatch must
+    # see only device-resident args (a Python-scalar add in the block
+    # would be its own h2d, masking what the test pins)
+    t_envs = [jnp.asarray(t, jnp.int32) for t in (0, 48, 96)]
+    keys = jnp.stack([jax.random.PRNGKey(i) for i in range(4)])
+    with compile_budget(1, match="_superstep"):
+        ts, _, infos = sup(ts, keys, t_envs[0])
+        with no_transfer():
+            ts, _, infos = sup(ts, keys, t_envs[1])
+        ts, _, infos = sup(ts, keys, t_envs[2])
+    assert "sight_td_hist" in infos
+    row = jax.tree.map(lambda x: x[2], infos)
+    assert np.asarray(jax.device_get(row["sight_td_hist"])).shape == (8,)
+
+
+@pytest.mark.slow
+@pytest.mark.graftprog
+def test_sight_off_fingerprints_match_checked_in_baseline():
+    """Acceptance pin: obs.sight off ⇒ the
+    train_iter/superstep/learner_train/dp_superstep fingerprints are
+    byte-identical to the checked-in (pre-sight) baselines — the static
+    gate compiles out entirely, zero re-baseline. Audited in a
+    SUBPROCESS (the CLI's own environment: conftest's
+    matmul-precision override changes lowered text in-process) — a
+    drift would fire GP304 and exit 1."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "t2omca_tpu.analysis", "--programs",
+         "--only", "train_iter", "--only", "superstep",
+         "--only", "learner_train", "--only", "dp_superstep"],
+        cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "0 new finding(s)" in proc.stdout
