@@ -948,6 +948,123 @@ def bench_superstep(cfg, _time, args) -> int:
     return 0
 
 
+def bench_population(cfg, _time, args) -> int:
+    """``--population P``: the graftpop experiment-throughput leg
+    (docs/POPULATION.md). ONE vmapped population superstep advances P
+    seed variants per dispatch (``run.Experiment.
+    population_superstep_program``); the A/B baseline is the SAME P
+    experiments run SERIALIZED — P sequential solo superstep dispatches
+    — which is exactly how the 16-AGV campaigns in git history burned
+    wall-clock. Headline: ``experiments_per_sec`` = experiment·train-
+    iters/s (P × per-dispatch iters / dispatch seconds); the record
+    carries both rates and ``population_speedup``."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from t2omca_tpu import population as graftpop
+    from t2omca_tpu.config import PopulationConfig
+    from t2omca_tpu.run import Experiment
+
+    p = args.population
+    k = 1                      # iters per dispatch: the speedup under
+    # measurement is the population axis, not the superstep scan
+    bs = 4 if args.smoke else 32
+    if args.smoke and not args.envs and not args.steps:
+        # the population smoke point: a deliberately dispatch-overhead-
+        # dominated workload (2 lanes × 2 slots) — the regime the axon
+        # tunnel's ~0.66 s/dispatch puts EVERY TPU workload in, and the
+        # one where the member-axis amortization is measurable on a
+        # CPU host at all (at CPU compute-bound scales the 2-core box
+        # caps the win near 1.5x; pass --envs/--steps to measure those)
+        cfg = cfg.replace(
+            batch_size_run=2,
+            env_args=dataclasses.replace(cfg.env_args, episode_limit=2))
+    b = cfg.batch_size_run
+    base = cfg.replace(
+        batch_size=bs,
+        replay=dataclasses.replace(
+            cfg.replay, prioritized=True,
+            buffer_size=max(cfg.replay.buffer_size, 2 * b, bs)))
+    pop_cfg = base.replace(population=PopulationConfig(size=p))
+
+    with _REC.span("bench.build", leg="population"):
+        exp = Experiment.build(pop_cfg)
+        ts, spec = graftpop.init_population(exp, pop_cfg)
+        # un-donated: the timed dispatches re-run on the same warm state
+        prog = exp.population_superstep_program(k)
+        solo_exp = Experiment.build(base)
+        solo_ts = solo_exp.init_train_state(0)
+        solo_prog = solo_exp.superstep_program(k)
+    keys = jnp.stack([jax.random.split(jax.random.PRNGKey(7 + m), k)
+                      for m in range(p)])
+    t_env = jnp.zeros((), jnp.int32)
+    # enough warm dispatches to FILL the ring past the train batch (each
+    # inserts k·b episodes), so the timed dispatches exercise the train
+    # branch of the gate in both modes — a fixed warm count would leave
+    # the gate closed at small --envs and time two different workloads
+    # (the vmapped select still executes-and-discards the train branch;
+    # the solo scalar cond genuinely skips it)
+    warm = max(2, -(-bs // (k * b)) + 1)
+    with _REC.span("bench.compile", leg="population", p=p, warm=warm):
+        for _ in range(warm):
+            ts, _, _ = prog(ts, keys, t_env, spec)
+            solo_ts, _, _ = solo_prog(solo_ts, keys[0], t_env)
+        gate_open = bool(jax.device_get(
+            exp.buffer.can_sample(
+                jax.tree.map(lambda x: x[0], ts.buffer), bs)))
+    if not gate_open:
+        print("# population: train gate CLOSED after warm-up — record "
+              "measures rollout+insert only", file=sys.stderr)
+
+    t1k = jnp.asarray(1000, jnp.int32)
+    with _REC.span("bench.measure", leg="population", mode="vmapped"):
+        dt_pop = _time(
+            lambda: prog(ts, keys, t1k, spec)[1].epsilon[-1, -1])
+
+    def _serial():
+        # the serialized A/B: the SAME P experiments as P SEPARATE
+        # sequential campaigns — which is what "running seeds serially"
+        # means: each run's driver loop syncs at its own cadences and
+        # two different processes' dispatches never overlap, so each
+        # solo dispatch is fetched before the next begins (state reuse
+        # is fine — this times dispatches, not learning)
+        out = None
+        for m in range(p):
+            out = solo_prog(solo_ts, keys[m], t1k)[1].epsilon[-1]
+            _sync(out)
+        return out
+    with _REC.span("bench.measure", leg="population", mode="serialized"):
+        dt_serial = _time(_serial)
+
+    pop_rate = p * k / dt_pop
+    serial_rate = p * k / dt_serial
+    speedup = dt_serial / dt_pop
+    print(f"# population P={p}: {dt_pop * 1e3:.1f} ms/dispatch vmapped "
+          f"vs {dt_serial * 1e3:.1f} ms for {p} serialized solo "
+          f"dispatches ({speedup:.2f}x; {b} envs, train batch {bs}, "
+          f"gate {'open' if gate_open else 'CLOSED'})", file=sys.stderr)
+    print(json.dumps(_finalize({
+        "metric": "experiments_per_sec",
+        "value": round(pop_rate, 2),
+        "unit": "experiment-train-iters/s/chip",
+        "vs_baseline": None,
+        "population": p,
+        "serialized_experiments_per_sec": round(serial_rate, 2),
+        "population_speedup": round(speedup, 3),
+        "config": (None if args.smoke or args.envs or args.steps
+                   else args.config),
+        "n_envs": b,
+        "episode_steps": cfg.env_args.episode_limit,
+        "train_batch_episodes": bs,
+        "train_gate_open": gate_open,
+        "dispatch_s": round(dt_pop, 4),
+        "serialized_dispatch_s": round(dt_serial, 4),
+    })))
+    return 0
+
+
 def bench_train(cfg, _time, args) -> int:
     """``--train``: the learner measurement alone, as the headline line."""
     nums = _train_numbers(cfg, _time, train_bs=4 if args.smoke else 32,
@@ -1388,6 +1505,7 @@ def _daemon_legs(args) -> list:
         ("superstep", ["--superstep", "4", *sm, *it]),
         ("kernels", ["--kernels", "ab", *sm, *it]),
         ("sebulba", ["--sebulba", *sm, *it]),
+        ("population", ["--population", "4", *sm, *it]),
     ]
     if args.artifact:
         legs.append(("serve",
@@ -1400,7 +1518,7 @@ def _daemon_legs(args) -> list:
         if unknown:
             raise SystemExit(
                 f"--legs: unknown leg(s) {sorted(unknown)}; valid: "
-                f"superstep,kernels,sebulba"
+                f"superstep,kernels,sebulba,population"
                 + (",serve" if args.artifact else
                    " (serve needs --artifact)"))
         legs = [(n, a) for n, a in legs if n in want]
@@ -1724,6 +1842,13 @@ def main() -> int:
                          "K=1 still fuses the three stages into one "
                          "program). Reports the dispatch-amortized "
                          "env-steps/s including training")
+    ap.add_argument("--population", type=int, default=None, metavar="P",
+                    help="graftpop experiment-throughput leg: ONE "
+                         "vmapped population superstep advancing P "
+                         "seed variants per dispatch vs the SAME P "
+                         "experiments serialized as P solo dispatches "
+                         "(docs/POPULATION.md). Reports experiments_"
+                         "per_sec + population_speedup")
     ap.add_argument("--daemon", action="store_true",
                     help="the surviving bench (ROADMAP item 1): retry "
                          "backend init on the backoff ladder until the "
@@ -1751,7 +1876,8 @@ def main() -> int:
     if args.daemon:
         if (args.all or args.hbm or args.prod_hbm or args.breakdown
                 or args.train or args.serve or args.superstep is not None
-                or args.kernels is not None or args.sebulba):
+                or args.kernels is not None or args.sebulba
+                or args.population is not None):
             ap.error("--daemon runs the full A/B matrix itself "
                      "(--superstep 4, --kernels ab, --sebulba, --serve "
                      "when --artifact is given); drop the per-leg flags")
@@ -1802,6 +1928,21 @@ def main() -> int:
         if args.pipeline:
             ap.error("--superstep already amortizes dispatch inside one "
                      "program; drop --pipeline")
+    if args.population is not None:
+        if args.population < 2:
+            ap.error("--population P must be >= 2 (P=1 is the classic "
+                     "loop — measure it with --superstep)")
+        if (args.all or args.hbm or args.prod_hbm or args.breakdown
+                or args.train or args.serve or args.superstep is not None
+                or args.kernels is not None or args.sebulba
+                or args.config == 5):
+            ap.error("--population measures the vmapped population "
+                     "superstep vs the serialized P-run; drop --all/"
+                     "--hbm/--prod-hbm/--breakdown/--train/--serve/"
+                     "--superstep/--kernels/--sebulba/--config 5")
+        if args.pipeline:
+            ap.error("--population amortizes dispatch across the "
+                     "member axis already; drop --pipeline")
     if args.sebulba:
         if (args.all or args.hbm or args.prod_hbm or args.breakdown
                 or args.train or args.serve or args.superstep is not None
@@ -1838,7 +1979,8 @@ def main() -> int:
                               or args.prod_hbm or args.serve
                               or args.superstep is not None
                               or args.kernels is not None
-                              or args.sebulba)
+                              or args.sebulba
+                              or args.population is not None)
         args.pipeline = 4 if measures_chain else 0
 
     if args.smoke or args.hbm:
@@ -2020,6 +2162,10 @@ def main() -> int:
     if args.superstep is not None:
         with tracing():
             return bench_superstep(cfg, _time, args)
+
+    if args.population is not None:
+        with tracing():
+            return bench_population(cfg, _time, args)
 
     if args.prod_hbm:
         if jax.device_count() < 8:

@@ -147,6 +147,12 @@ TRACE_SYMBOLS = {
     # fuses into the scan body with no distinct symbol.
     "env_reset": ("jit__env_reset", "PjitFunction(_env_reset)"),
     "env_step": ("jit__env_step", "PjitFunction(_env_step)"),
+    # graftpop population superstep (run.population_superstep_program):
+    # the vmapped fused program dispatched by the population driver
+    # loop — distinct wrapper name, so attribution never collides with
+    # the single-member superstep
+    "superstep_pop": ("jit__superstep_pop",
+                      "PjitFunction(_superstep_pop)"),
 }
 
 
@@ -214,6 +220,44 @@ def sight_audit_config():
     cfg = audit_config()
     return cfg.replace(obs=_dc.replace(
         cfg.obs, sight=SightConfig(enabled=True, bins=8)))
+
+
+def population_audit_config():
+    """The frozen config for the graftpop twin entry (``superstep_pop``
+    — run.py's ``_population_twin_programs``): ``audit_config`` with a
+    FIXED P=2 population, so the twin-vs-base budget delta is the
+    vmapped population axis and nothing else. The population-OFF
+    fingerprints of every other entry are unaffected (the spec seams
+    default to ``None``)."""
+    from ..config import PopulationConfig
+    cfg = audit_config()
+    return cfg.replace(population=PopulationConfig(size=2))
+
+
+_pctx: Optional[AuditContext] = None
+
+
+def population_audit_context() -> AuditContext:
+    """Build (once per process) the population audit context — the
+    ``sight_audit_context`` caching pattern. ``ts_shape`` follows the
+    context convention of being the aval the audit program takes: here
+    the ``(ts, spec)`` PAIR of ``population.init_population`` avals —
+    every leaf (P,)-STACKED — since ``superstep_pop`` consumes both
+    (an unstacked TrainState aval would fail its vmap at trace time)."""
+    global _pctx
+    with _ctx_lock:
+        if _pctx is None:
+            import jax
+
+            from .. import population as graftpop
+            from ..run import Experiment
+            cfg = population_audit_config()
+            exp = Experiment.build(cfg)
+            ts_shape = jax.eval_shape(
+                lambda: graftpop.init_population(exp, cfg))
+            _pctx = AuditContext(cfg=cfg, exp=exp, ts_shape=ts_shape,
+                                 superstep_k=AUDIT_SUPERSTEP_K)
+        return _pctx
 
 
 _sctx: Optional[AuditContext] = None

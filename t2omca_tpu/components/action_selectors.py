@@ -46,15 +46,22 @@ def random_avail(key: jax.Array, avail: jnp.ndarray) -> jnp.ndarray:
 class EpsilonGreedySelector:
     schedule: DecayThenFlatSchedule
 
-    def epsilon(self, t_env: jnp.ndarray, test_mode: bool) -> jnp.ndarray:
+    def epsilon(self, t_env: jnp.ndarray, test_mode: bool,
+                eps_scale=None) -> jnp.ndarray:
+        """``eps_scale`` (optional traced scalar) multiplies the
+        schedule's epsilon — the graftpop per-member exploration knob
+        (``population.eps_scale``). ``None`` (every pre-population
+        caller) is byte-identical; 1.0 is bitwise-neutral."""
         eps = self.schedule.eval(t_env)
+        if eps_scale is not None:
+            eps = eps * eps_scale
         return jnp.where(jnp.asarray(test_mode), 0.0, eps)
 
     def select(self, key: jax.Array, q: jnp.ndarray, avail: jnp.ndarray,
-               t_env: jnp.ndarray, test_mode: bool = False
-               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+               t_env: jnp.ndarray, test_mode: bool = False,
+               eps_scale=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """q, avail: ``(..., n_actions)`` → (actions ``(...)``, epsilon)."""
-        eps = self.epsilon(t_env, test_mode)
+        eps = self.epsilon(t_env, test_mode, eps_scale)
         k_coin, k_rand = jax.random.split(key)
         explore = jax.random.uniform(k_coin, q.shape[:-1]) < eps
         actions = jnp.where(explore, random_avail(k_rand, avail),
@@ -68,13 +75,16 @@ class NoisySelector:
 
     schedule: DecayThenFlatSchedule  # kept so `.epsilon` still logs (always 0)
 
-    def epsilon(self, t_env: jnp.ndarray, test_mode: bool) -> jnp.ndarray:
+    def epsilon(self, t_env: jnp.ndarray, test_mode: bool,
+                eps_scale=None) -> jnp.ndarray:
         return jnp.zeros(())
 
     def select(self, key: jax.Array, q: jnp.ndarray, avail: jnp.ndarray,
-               t_env: jnp.ndarray, test_mode: bool = False
-               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        del key
+               t_env: jnp.ndarray, test_mode: bool = False,
+               eps_scale=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        # NoisyNet exploration lives in the q-head, so the population
+        # eps knob has nothing to scale here
+        del key, eps_scale
         return masked_argmax(q, avail), jnp.zeros(())
 
 

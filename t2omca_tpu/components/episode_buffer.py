@@ -217,14 +217,20 @@ class ReplayBuffer:
                 f"batch_size_run")
         return (state.insert_pos + jnp.arange(b)) % self.capacity
 
-    def _insert_priority(self, state: BufferState) -> jnp.ndarray:
+    def _insert_priority(self, state: BufferState,
+                         alpha: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         """STORED priority stamped on freshly inserted episodes: the raw
         running max here; the prioritized subclass pre-exponentiates
-        (one scalar pow per insert — the storage convention)."""
+        (one scalar pow per insert — the storage convention). ``alpha``
+        (a traced scalar) overrides the static exponent — the graftpop
+        per-member PER-alpha seam; ``None`` (every pre-population
+        caller) is byte-identical to the static path."""
+        del alpha
         return state.max_priority
 
     def _ring_advance(self, state: BufferState, storage: EpisodeBatch,
-                      idx: jnp.ndarray, b: int) -> BufferState:
+                      idx: jnp.ndarray, b: int,
+                      alpha: Optional[jnp.ndarray] = None) -> BufferState:
         """Post-insert bookkeeping shared by both insert paths: advance
         the ring cursor/fill and stamp new episodes at the running max
         priority (standard PER; reference feeds real |TD| back after the
@@ -235,11 +241,13 @@ class ReplayBuffer:
             episodes_in_buffer=jnp.minimum(
                 state.episodes_in_buffer + b, self.capacity),
             priorities=state.priorities.at[idx].set(
-                self._insert_priority(state)),
+                self._insert_priority(state, alpha)),
         )
 
     def insert_episode_batch(self, state: BufferState,
-                             batch: EpisodeBatch) -> BufferState:
+                             batch: EpisodeBatch,
+                             alpha: Optional[jnp.ndarray] = None
+                             ) -> BufferState:
         """Ring-insert ``B`` episodes; overwrites oldest when full (the
         reference's EpisodeBatch ring semantics)."""
         b = batch.batch_size
@@ -249,10 +257,12 @@ class ReplayBuffer:
         storage = jax.tree.map(
             lambda s, x: s.at[idx].set(x.astype(s.dtype)), state.storage,
             batch)
-        return self._ring_advance(state, storage, idx, b)
+        return self._ring_advance(state, storage, idx, b, alpha)
 
     def insert_time_major(self, state: BufferState,
-                          tm: TimeMajorEpisodes) -> BufferState:
+                          tm: TimeMajorEpisodes,
+                          alpha: Optional[jnp.ndarray] = None
+                          ) -> BufferState:
         """Ring-insert straight from the rollout scan's time-major
         emission: ONE scatter per leaf via a combined ``(slot, t)``
         index map. The former path did two scatters per (T+1)-length
@@ -300,7 +310,7 @@ class ReplayBuffer:
             terminated=put_t(st.terminated, tm.terminated),
             filled=st.filled.at[idx].set(True),
         )
-        return self._ring_advance(state, storage, idx, b)
+        return self._ring_advance(state, storage, idx, b, alpha)
 
     def can_sample(self, state: BufferState, batch_size: int) -> jnp.ndarray:
         return state.episodes_in_buffer >= batch_size
@@ -325,9 +335,10 @@ class ReplayBuffer:
 
     def update_priorities(self, state: BufferState, idx: jnp.ndarray,
                           priorities: jnp.ndarray,
-                          valid: Optional[jnp.ndarray] = None
+                          valid: Optional[jnp.ndarray] = None,
+                          alpha: Optional[jnp.ndarray] = None
                           ) -> BufferState:
-        del idx, priorities, valid
+        del idx, priorities, valid, alpha
         return state  # uniform: no-op
 
 
@@ -342,11 +353,16 @@ class PrioritizedReplayBuffer(ReplayBuffer):
     beta0: float = 0.4
     t_max: int = 1
 
-    def _insert_priority(self, state: BufferState) -> jnp.ndarray:
+    def _insert_priority(self, state: BufferState,
+                         alpha: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         # storage convention: stored values are pre-exponentiated, so
         # the fresh-episode stamp is max^alpha (one scalar pow per
-        # insert; bit-identical to exponentiating at sample time)
-        return state.max_priority ** self.alpha
+        # insert; bit-identical to exponentiating at sample time). A
+        # traced `alpha` is the graftpop per-member exponent — the same
+        # pow on the same values at the config default, so the
+        # population path is value-identical to the static one.
+        return state.max_priority ** (self.alpha if alpha is None
+                                      else alpha)
 
     def _probs(self, state: BufferState) -> jnp.ndarray:
         # stored values are ALREADY p^alpha (pre-exponentiated at
@@ -376,7 +392,8 @@ class PrioritizedReplayBuffer(ReplayBuffer):
 
     def update_priorities(self, state: BufferState, idx: jnp.ndarray,
                           priorities: jnp.ndarray,
-                          valid: Optional[jnp.ndarray] = None
+                          valid: Optional[jnp.ndarray] = None,
+                          alpha: Optional[jnp.ndarray] = None
                           ) -> BufferState:
         """Feed RAW |TD|+1e-6 back for the sampled episodes (Q9); the
         stored form is pre-exponentiated (``p^alpha``, one O(batch) pow
@@ -390,8 +407,13 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         not updating, with no host sync and no full-ring select (the
         guard the driver used to inline; it moved here when the storage
         went pre-exponentiated, so the fallback reads stored-space
-        values)."""
-        pa = priorities ** self.alpha
+        values).
+
+        ``alpha`` (optional traced scalar) overrides the static
+        exponent — the graftpop per-member PER-alpha seam (each vmapped
+        member's ring then stores ``p^alpha_i`` consistently across
+        insert-stamp, feedback and sample-normalize)."""
+        pa = priorities ** (self.alpha if alpha is None else alpha)
         new_max = jnp.maximum(state.max_priority, priorities.max())
         if valid is not None:
             pa = jnp.where(valid, pa, state.priorities[idx])

@@ -391,6 +391,66 @@ class SebulbaConfig:
 
 
 @dataclass(frozen=True)
+class PBTConfig:
+    """Population-based-training exploit/explore (``population.pbt.*``,
+    t2omca_tpu/population.py). Host-side select-and-perturb on the
+    population axis at checkpoint-save boundaries ONLY — zero extra
+    steady-state dispatches. Off by default; enabling it deliberately
+    breaks the member-0/solo bit-parity contract (that is its job)."""
+
+    enabled: bool = False
+    # exploit fraction: the bottom `frac` members copy the full train
+    # state of the top `frac` at each save boundary (clamped so the two
+    # sets never overlap)
+    frac: float = 0.25
+    # explore: copied members multiply each spec leaf (lr_scale,
+    # eps_scale, per_alpha) by `perturb` or `1/perturb` (coin flip,
+    # deterministic in (seed, t_env))
+    perturb: float = 1.2
+
+
+@dataclass(frozen=True)
+class PopulationConfig:
+    """graftpop population axis (``population.*``, docs/POPULATION.md,
+    t2omca_tpu/population.py): ``size=P`` vmaps the WHOLE training
+    state — params, opt_state, replay ring + PER priorities, runner
+    state, RNG keys, per-member EnvParams scenario draws — over a
+    leading ``(P,)`` axis, so ONE donated superstep dispatch advances P
+    seed/hyperparameter variants. ``size=0`` (default) leaves every
+    compiled program byte-identical (graftprog fingerprints pinned —
+    zero re-baseline). Per-member grids are tuples of length P (empty =
+    replicate the base config's value, the bit-parity-neutral default);
+    P=1 with empty grids is bit-identical to the classic loop and
+    member 0 of any un-gridded population is bit-exactly the solo run
+    at ``cfg.seed`` (tests/test_population.py)."""
+
+    size: int = 0
+    # per-member ABSOLUTE learning rates (len P; empty = cfg.lr for
+    # every member). Applied as an update-tree scale of lr_i/cfg.lr —
+    # exact for adam/rmsprop, where lr enters linearly after the
+    # moment statistics.
+    lr: Tuple[float, ...] = ()
+    # per-member multipliers on the epsilon-greedy schedule (len P;
+    # empty = 1.0 — bitwise-neutral)
+    eps_scale: Tuple[float, ...] = ()
+    # per-member ABSOLUTE PER priority exponents (len P; empty =
+    # replay.per_alpha). Traced into the store-side pow — value-
+    # identical to the static exponent at the default.
+    per_alpha: Tuple[float, ...] = ()
+    # member i seeds from cfg.seed + i*seed_stride: 1 (default) = seed
+    # replication (member 0 == the solo run), 0 = identical seeds
+    # (controlled grid comparisons; combine with scenario_salt below)
+    seed_stride: int = 1
+    # fold the member index into the graftworld scenario sampler key
+    # (envs/graftworld.member_scenario_key) so members draw DIFFERENT
+    # scenario instances even at seed_stride=0. Off by default: the
+    # fold is not bitwise-neutral, so member 0 would no longer match
+    # the solo run's env streams.
+    scenario_salt: bool = False
+    pbt: "PBTConfig" = field(default_factory=lambda: PBTConfig())
+
+
+@dataclass(frozen=True)
 class KernelsConfig:
     """Rollout hot-path kernel selection (``t2omca_tpu/kernels/``,
     docs/PERF.md). Every entry keeps the XLA lowering as the default
@@ -539,6 +599,7 @@ class TrainConfig:
     obs: ObsConfig = field(default_factory=ObsConfig)
     kernels: KernelsConfig = field(default_factory=KernelsConfig)
     sebulba: SebulbaConfig = field(default_factory=SebulbaConfig)
+    population: PopulationConfig = field(default_factory=PopulationConfig)
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
@@ -758,6 +819,71 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
                 f"{cfg.replay.buffer_size} must be divisible by "
                 f"sebulba.learner_devices={sb.learner_devices} (replay "
                 f"episodes shard over the learner mesh)")
+    pp = cfg.population
+    if pp.size < 0:
+        raise ValueError(f"population.size must be >= 0 (0 = no "
+                         f"population axis), got {pp.size}")
+    if pp.size:
+        if cfg.replay.buffer_cpu_only:
+            raise ValueError(
+                "the population superstep vmaps the device-resident "
+                "replay ring; buffer_cpu_only keeps storage in host RAM "
+                "— pick one")
+        if cfg.dp_devices:
+            raise ValueError(
+                "population does not compose with dp_devices yet "
+                "(ROADMAP item 2 names sharding the population over dp "
+                "as the composition) — pick one")
+        if cfg.sebulba.actor_devices:
+            raise ValueError(
+                "population vmaps the fused superstep; sebulba decouples "
+                "it onto disjoint device sets — pick one")
+        if cfg.kernels.attention != "xla":
+            raise ValueError(
+                "population does not compose with kernels.attention="
+                "'pallas' yet (vmap over the hand-written kernel grid is "
+                "unvalidated on this JAX) — use the xla lowering")
+        if cfg.evaluate or cfg.save_replay or cfg.save_animation:
+            raise ValueError(
+                "population trains P stacked members; the evaluate/"
+                "replay/animation paths run a single-member policy — "
+                "evaluate a member by exporting its slice (docs/"
+                "POPULATION.md)")
+        for name, grid in (("lr", pp.lr), ("eps_scale", pp.eps_scale),
+                           ("per_alpha", pp.per_alpha)):
+            if grid and len(grid) != pp.size:
+                raise ValueError(
+                    f"population.{name} has {len(grid)} entries for "
+                    f"population.size={pp.size} — per-member grids must "
+                    f"have exactly P entries (or be empty = replicate)")
+            if any(v <= 0 for v in grid):
+                raise ValueError(f"population.{name} entries must be > 0, "
+                                 f"got {grid}")
+        if any(v > 1.0 for v in pp.per_alpha):
+            raise ValueError(f"population.per_alpha entries must be in "
+                             f"(0, 1], got {pp.per_alpha}")
+        if pp.per_alpha and not cfg.replay.prioritized:
+            raise ValueError(
+                "population.per_alpha grids the PER exponent — with "
+                "replay.prioritized=false the knob is silently dead "
+                "(same policy as first_dispatch_timeout without "
+                "dispatch_timeout)")
+        if pp.seed_stride < 0:
+            raise ValueError(f"population.seed_stride must be >= 0, got "
+                             f"{pp.seed_stride}")
+        if not 0.0 < pp.pbt.frac <= 0.5:
+            raise ValueError(f"population.pbt.frac must be in (0, 0.5] "
+                             f"(exploit/explore sets must not overlap), "
+                             f"got {pp.pbt.frac}")
+        if pp.pbt.perturb <= 1.0:
+            raise ValueError(f"population.pbt.perturb must be > 1.0 (the "
+                             f"multiplicative explore factor), got "
+                             f"{pp.pbt.perturb}")
+        if pp.pbt.enabled and not cfg.save_model:
+            raise ValueError(
+                "population.pbt runs at checkpoint-save boundaries — "
+                "with save_model=false it never fires (dead-knob "
+                "policy); set save_model=true too")
     if cfg.kernels.attention not in ("xla", "pallas"):
         raise ValueError(f"kernels.attention must be xla/pallas, got "
                          f"{cfg.kernels.attention!r}")
@@ -874,6 +1000,15 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
     obs_kw = dict(updates.pop("obs", {}) or {})
     kernels_kw = dict(updates.pop("kernels", {}) or {})
     sebulba_kw = dict(updates.pop("sebulba", {}) or {})
+    # `population: 4` (bare int, YAML/CLI shorthand) means {size: 4} —
+    # the ISSUE-15 config surface; a dict/PopulationConfig is the full
+    # block form
+    pop_raw = updates.pop("population", None)
+    if isinstance(pop_raw, PopulationConfig):
+        pop_raw = dataclasses.asdict(pop_raw)
+    if isinstance(pop_raw, (int, float)) and not isinstance(pop_raw, bool):
+        pop_raw = {"size": int(pop_raw)}
+    population_kw = dict(pop_raw or {})
 
     # route flat keys to their sub-config for reference-style flat configs
     env_fields = {f.name for f in dataclasses.fields(EnvConfig)}
@@ -951,6 +1086,26 @@ def _merge_nested(cfg: TrainConfig, updates: dict) -> TrainConfig:
         updates["kernels"] = dataclasses.replace(cfg.kernels, **kernels_kw)
     if sebulba_kw:
         updates["sebulba"] = dataclasses.replace(cfg.sebulba, **sebulba_kw)
+    if population_kw:
+        # pbt sub-tree: a nested dict (YAML), dotted keys (CLI
+        # `population.pbt.enabled=...` arrives here as "pbt.enabled"),
+        # or an already-built PBTConfig (from_dict re-entry)
+        pbt_kw = population_kw.pop("pbt", None)
+        pbt_kw = ({} if pbt_kw is None
+                  else dataclasses.asdict(pbt_kw)
+                  if isinstance(pbt_kw, PBTConfig) else dict(pbt_kw))
+        for k in [k for k in population_kw if k.startswith("pbt.")]:
+            pbt_kw[k.split(".", 1)[1]] = population_kw.pop(k)
+        if pbt_kw:
+            population_kw["pbt"] = dataclasses.replace(cfg.population.pbt,
+                                                       **pbt_kw)
+        # YAML lists → the frozen tuples the hashable config needs
+        for k in ("lr", "eps_scale", "per_alpha"):
+            if k in population_kw:
+                population_kw[k] = tuple(float(v)
+                                         for v in population_kw[k])
+        updates["population"] = dataclasses.replace(cfg.population,
+                                                    **population_kw)
     return cfg.replace(**updates)
 
 
@@ -992,7 +1147,22 @@ def load_config(path: Optional[str] = None,
         val = _coerce(v)
         if "." in k:
             sec, sub = k.split(".", 1)
+            if sec == "population" and isinstance(updates.get(sec),
+                                                 (int, float)):
+                # the bare-int shorthand already stored —
+                # `population=4 population.seed_stride=1` — lift it to
+                # its dict form so the dotted key composes instead of
+                # crashing on int.__setitem__
+                updates[sec] = {"size": int(updates[sec])}
             updates.setdefault(sec, {})[sub] = val
+        elif (k == "population" and isinstance(updates.get(k), dict)
+                and not isinstance(val, dict)):
+            # the reversed order: dotted keys first, then the bare-int
+            # shorthand — merge instead of silently replacing the dict
+            # (dropping `population.seed_stride=0` would turn a
+            # controlled grid comparison into divergent seeds with no
+            # error)
+            updates[k]["size"] = int(val)
         else:
             updates[k] = val
     cfg = _merge_nested(cfg, updates)

@@ -230,12 +230,14 @@ class BasicMAC:
     def select_actions(self, params, obs: jnp.ndarray, avail: jnp.ndarray,
                        hidden: jnp.ndarray, key: jax.Array,
                        t_env: jnp.ndarray, test_mode: bool = False,
-                       compact=None
+                       compact=None, eps_scale=None
                        ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """→ (actions ``(B, A)`` int32, hidden', epsilon). The avail mask is
         applied inside the selector (illegal-action masking, M7).
         ``compact`` (the batched ``env.compact_obs`` tuple) activates the
-        entity-table forward when the MAC was built eligible."""
+        entity-table forward when the MAC was built eligible.
+        ``eps_scale`` (optional traced scalar) is the graftpop
+        per-member epsilon multiplier, forwarded to the selector."""
         k_noise, k_sel = jax.random.split(key)
         if self.use_entity_tables and compact is not None:
             q, hidden = self.forward_entity(params, compact, hidden,
@@ -251,7 +253,8 @@ class BasicMAC:
             q, hidden = self.forward(params, obs, hidden, key=k_noise,
                                      deterministic=test_mode, acting=True)
         actions, eps = self.selector.select(k_sel, q, avail, t_env,
-                                            test_mode=test_mode)
+                                            test_mode=test_mode,
+                                            eps_scale=eps_scale)
         return actions.astype(jnp.int32), hidden, eps
 
 

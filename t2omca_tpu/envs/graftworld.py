@@ -257,6 +257,48 @@ def make_distribution(scn) -> ScenarioDistribution:
                      f"valid: fixed/uniform/mixture")
 
 
+def member_scenario_key(key: jax.Array, member: jnp.ndarray) -> jax.Array:
+    """graftpop per-member scenario decorrelation
+    (``population.scenario_salt``): fold the population member index
+    into the scenario sampler key, so vmapped members draw DIFFERENT
+    EnvParams instances from the SAME distribution even when their seed
+    streams are replicated (``population.seed_stride=0``). A plain
+    ``fold_in`` — never a split — for the same reason as the runner's
+    ``_SCENARIO_SALT``: splitting would re-pair the threefry counters
+    of the existing key chain. Deliberately NOT applied by default:
+    ``fold_in(key, 0)`` is not the identity, so member 0 would stop
+    matching the solo run's env streams."""
+    return jax.random.fold_in(key, member)
+
+
+def distribution_can_pad(dist: ScenarioDistribution,
+                         n_agents: int) -> bool:
+    """STATIC predicate: can ``dist`` ever draw ``n_active < n_agents``
+    (i.e. produce padded agents)? Drives the learner's mixer-side
+    padding mask (learners/qmix_learner.py) as a config-static gate —
+    distributions that never pad (every pre-graftworld config, the
+    audit config) leave the loss program byte-identical, so the
+    graftprog fingerprints of the hot train programs never move for
+    them (ROADMAP item 3's open remainder, ISSUE 15 satellite)."""
+    if isinstance(dist, MixtureScenario):
+        return any(distribution_can_pad(c, n_agents)
+                   for c in dist.components)
+    min_agents = getattr(dist, "min_agents", 0)
+    if min_agents and min_agents < n_agents:
+        return True
+    for name, value in getattr(dist, "overrides", ()):
+        # n_active is a scalar leaf — a ("linspace", ...) form here
+        # would be a config error, never a padding opt-in
+        if (name == "n_active" and not isinstance(value, tuple)
+                and float(value) < n_agents):
+            return True
+    if isinstance(dist, UniformScenario):
+        for name, lo, _hi in dist.effective_ranges():
+            if name == "n_active" and float(lo) < n_agents:
+                return True
+    return False
+
+
 def register_audit_programs(ctx):
     """graftprog registry hook: the vmapped PARAMETERIZED env programs,
     lowered over a mixture spanning every family — the scenario-path

@@ -138,6 +138,20 @@ class QMixLearner:
         from ..ops.query_slice import mixer_qslice_eligible
         return mixer_qslice_eligible(self.cfg)
 
+    @property
+    def _mask_padded(self) -> bool:
+        """STATIC gate for the mixer-side padding mask (ROADMAP item 3's
+        open remainder): True only when the config's scenario
+        distribution can draw ``n_active < n_agents``. Every
+        non-padding config (the classic fixed scenario, the audit
+        config) compiles the exact pre-mask loss — graftprog
+        fingerprints of the hot train programs stay byte-identical."""
+        from ..envs.graftworld import distribution_can_pad
+        from ..envs.registry import make_scenario_distribution
+        return distribution_can_pad(
+            make_scenario_distribution(self.cfg.env_args),
+            self.mac.n_agents)
+
 
     def _scan_body(self, body):
         """Wrap a scan body with jax.checkpoint when ``model.remat``: the
@@ -350,8 +364,54 @@ class QMixLearner:
         target_qs, target_hs = self._unroll_agent(
             target_params["agent"], obs, k_tag, compact_tm=compact_tm)
 
-        chosen = jnp.take_along_axis(
-            qs[:-1], actions[..., None], axis=-1)[..., 0]  # (T, B, A)
+        # mixer-side padding mask (graftworld fleet-size randomization,
+        # ROADMAP item 3's open remainder): padded agents are
+        # action-0-only at EVERY step by construction (the env masks
+        # them at reset and they can never acquire a job) AND always
+        # occupy the TRAILING agent slots (EnvParams.agent_mask is
+        # `arange < n_active`), so the maximal trailing block of agents
+        # with "no non-idle action ever available across the episode
+        # incl. the bootstrap step" identifies them from the stored
+        # avail mask alone — no schema change, works for dense AND
+        # compact storage. The suffix rule matters: an ACTIVE agent
+        # whose job stream delivered nothing all episode is also
+        # idle-only-forever, and a plain any-step test would zero its
+        # (real) idle-Q contribution; with the suffix rule it is only
+        # conservatively masked when every agent after it is idle-only
+        # too (rare — and its sole contribution would have been the
+        # idle-action Q of an agent that never interacted). Masked
+        # agents' chosen/target Qs and hidden tokens enter the mixer
+        # multiplied by 0.0 (the neutral contribution of a monotonic
+        # mixer); active agents multiply by 1.0, which is bitwise-
+        # identity, so a full-fleet batch where any tail agent saw a
+        # job is bit-identical to the unmasked loss (pinned by
+        # tests/test_population.py). The gate is config-STATIC
+        # (_mask_padded): non-padding configs never compile any of
+        # this.
+        if self._mask_padded:
+            saw_job = (avail[..., 1:] > 0).any(axis=(0, -1))  # (B, A)
+            # active = suffix-any of saw_job: agent i is masked only
+            # when agents i..A-1 ALL never saw a job (the padded tail)
+            act_m = jnp.flip(jax.lax.cummax(
+                jnp.flip(saw_job.astype(jnp.int32), -1), axis=1),
+                -1) > 0
+
+            def _padmask(x):
+                # zero padded agents along the trailing agent axis
+                # (x: (T?, B, A) or (T?, B, A, F))
+                m = act_m.astype(x.dtype)
+                return x * (m[None] if x.ndim == 3 else m[None, ..., None])
+
+            hs, target_hs = _padmask(hs), _padmask(target_hs)
+            if obs is not None:
+                # Q12 fallback path: the mixer tokenizes all agents'
+                # obs — padded rows go in as zeros too
+                obs = _padmask(obs)
+        else:
+            _padmask = lambda x: x  # noqa: E731 — static no-op branch
+
+        chosen = _padmask(jnp.take_along_axis(
+            qs[:-1], actions[..., None], axis=-1)[..., 0])  # (T, B, A)
 
         # illegal actions suppressed in targets (MAC masking contract);
         # computed over ALL T+1 steps so the target mixer can unroll its
@@ -365,6 +425,7 @@ class QMixLearner:
         else:
             target_max = jnp.where(
                 avail > 0, target_qs, -jnp.inf).max(axis=-1)
+        target_max = _padmask(target_max)
 
         obs_m = None if obs is None else obs[:-1]
         q_tot = self._unroll_mixer(
@@ -464,12 +525,21 @@ class QMixLearner:
 
     def train(self, ls: LearnerState, batch: EpisodeBatch,
               weights: jnp.ndarray, t_env: jnp.ndarray,
-              episode: jnp.ndarray, key: Optional[jax.Array] = None
-              ) -> Tuple[LearnerState, Dict[str, jnp.ndarray]]:
+              episode: jnp.ndarray, key: Optional[jax.Array] = None,
+              spec=None) -> Tuple[LearnerState, Dict[str, jnp.ndarray]]:
         """One importance-weighted QMIX update; hard target sync every
         ``target_update_interval`` episodes (PyMARL convention, M8).
         ``key`` drives NoisyLinear/dropout sampling and is required when the
         config uses either (otherwise sigma params get zero gradient).
+
+        ``spec`` (a graftpop ``PopulationSpec`` of traced per-member
+        scalars, ``None`` for every pre-population caller) applies the
+        member's learning rate as an update-tree scale: lr enters
+        optax's adam/rmsprop linearly AFTER the moment statistics, so
+        ``updates · (lr_i/lr)`` is exactly training at ``lr_i`` — and
+        the clip-by-global-norm rung acts on raw gradients, which are
+        lr-independent. 1.0 multiplies bitwise-identically (the P=1
+        parity contract).
 
         Non-finite guard rail (docs/RESILIENCE.md): ``info["all_finite"]``
         flags whether loss AND gradients came out finite; when it trips,
@@ -509,6 +579,11 @@ class QMixLearner:
                       & jnp.isfinite(info["grad_norm"]))
         info["all_finite"] = all_finite
         updates, opt_state = opt.update(grads, ls.opt_state, ls.params)
+        if spec is not None:
+            # graftpop per-member lr: scale the update tree (exact — see
+            # the docstring; opt_state is lr-independent by construction)
+            updates = jax.tree.map(
+                lambda u: u * spec.lr_scale.astype(u.dtype), updates)
         params = optax.apply_updates(ls.params, updates)
         # guard rail: a tripped step is a no-op on params AND opt state
         # (a NaN grad corrupts Adam's mu/nu permanently, so opt_state must

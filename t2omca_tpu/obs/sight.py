@@ -384,10 +384,18 @@ class SightMonitor:
     :meth:`wire_pulse` read the CURRENT verdicts — the endpoint flips
     503 naming the detector the moment one trips."""
 
-    def __init__(self, sight_cfg, logger=None, rec=None):
+    def __init__(self, sight_cfg, logger=None, rec=None,
+                 member: Optional[int] = None):
         self.cfg = sight_cfg
         self.logger = logger
         self.rec = rec
+        #: graftpop member index (docs/POPULATION.md): set by
+        #: PopulationSightMonitor when P > 1 — logged stat keys gain a
+        #: ``pop<i>_`` prefix and /healthz checks register as
+        #: ``sight-pop<i>-<detector>``; None (solo runs, and the P=1
+        #: population for metric-stream parity) keeps today's names
+        self.member = member
+        self._prefix = f"pop{member}_" if member is not None else ""
         self._window: deque = deque(maxlen=int(sight_cfg.window))
         self.status: Dict[str, dict] = {
             name: {"ok": True, "detail": "no data", "t_env": 0}
@@ -410,7 +418,7 @@ class SightMonitor:
         if self.logger is not None:
             for k in sorted(vals):
                 if k.startswith("sight_"):
-                    self.logger.log_stat(k, vals[k], t_env)
+                    self.logger.log_stat(self._prefix + k, vals[k], t_env)
         self._window.append(vals)
         newly: List[str] = []
         for name, (ok, detail) in self._evaluate().items():
@@ -418,14 +426,17 @@ class SightMonitor:
             self.status[name] = {"ok": ok, "detail": detail,
                                  "t_env": int(t_env)}
             if ok != prev and self.logger is not None:
-                self.logger.log_stat(f"sight_alert_{name}",
+                self.logger.log_stat(f"{self._prefix}sight_alert_{name}",
                                      0.0 if ok else 1.0, t_env)
             if prev and not ok:
                 self.trips_total += 1
-                newly.append(name)
+                newly.append(name if self.member is None
+                             else f"pop{self.member}:{name}")
                 if self.rec is not None:
+                    mark_kw = ({} if self.member is None
+                               else {"member": self.member})
                     self.rec.mark("sight", detector=name, t_env=t_env,
-                                  detail=detail[:200])
+                                  detail=detail[:200], **mark_kw)
         return newly
 
     # -- detectors -------------------------------------------------------
@@ -552,22 +563,72 @@ class SightMonitor:
 
     def wire_pulse(self, hub) -> None:
         """Register one ``/healthz`` check per detector: the endpoint
-        names the tripped check (``sight-<detector>``) so a supervisor
-        needs no JSON spelunking to know WHY the run degraded."""
+        names the tripped check (``sight-<detector>``, or
+        ``sight-pop<i>-<detector>`` for a population member) so a
+        supervisor needs no JSON spelunking to know WHY the run
+        degraded."""
+        tag = f"pop{self.member}-" if self.member is not None else ""
         for name in DETECTORS:
             hub.health(
-                f"sight-{name}",
+                f"sight-{tag}{name}",
                 lambda name=name: (self.status[name]["ok"],
                                    self.status[name]["detail"]))
 
 
-def make_monitor(obs_cfg, logger=None, rec=None) -> Optional[SightMonitor]:
+class PopulationSightMonitor:
+    """graftpop (docs/POPULATION.md): one :class:`SightMonitor` PER
+    population member over the same log-cadence fetch — the fetched
+    train-info leaves carry a leading ``(P,)`` member axis (the
+    population superstep's vmapped output; the in-graph reduces are
+    rank-polymorphic since PR 14), and each member's slice feeds its
+    own windowed detector state. Zero extra device traffic: the slice
+    is host-side numpy indexing on the already-fetched arrays.
+
+    At P > 1 each member's stats log under ``pop<i>_sight_*``, its
+    ``/healthz`` checks register as ``sight-pop<i>-<detector>``, and
+    trips report as ``pop<i>:<detector>``. At P == 1 the single member
+    keeps the solo key/check names — the metric stream of a P=1
+    population is the solo run's (the bit-parity contract)."""
+
+    def __init__(self, sight_cfg, population: int, logger=None, rec=None):
+        self.population = int(population)
+        self.members = [
+            SightMonitor(sight_cfg, logger=logger, rec=rec,
+                         member=(m if self.population > 1 else None))
+            for m in range(self.population)]
+
+    def observe(self, info: dict, t_env: int) -> List[str]:
+        newly: List[str] = []
+        for m, mon in enumerate(self.members):
+            sliced = {}
+            for k, v in info.items():
+                a = np.asarray(v)
+                sliced[k] = a[m] if a.ndim else a
+            newly.extend(mon.observe(sliced, t_env))
+        return newly
+
+    def report(self) -> dict:
+        return {"population": self.population,
+                "members": [mon.report() for mon in self.members]}
+
+    def wire_pulse(self, hub) -> None:
+        for mon in self.members:
+            mon.wire_pulse(hub)
+
+
+def make_monitor(obs_cfg, logger=None, rec=None, population: int = 0
+                 ) -> Optional[object]:
     """Driver constructor: None unless ``obs.sight.enabled`` (the
     byte-identical off state — the driver hot loop stays one
-    ``if sight_mon is not None`` away from today's)."""
+    ``if sight_mon is not None`` away from today's). ``population=P``
+    (graftpop) returns the per-member :class:`PopulationSightMonitor`
+    over the ``(P,)``-leading fetched leaves."""
     sg = getattr(obs_cfg, "sight", None)
     if sg is None or not getattr(sg, "enabled", False):
         return None
+    if population:
+        return PopulationSightMonitor(sg, population, logger=logger,
+                                      rec=rec)
     return SightMonitor(sg, logger=logger, rec=rec)
 
 
@@ -701,6 +762,46 @@ def render_learning(run_dir: str, series: Dict[str, list]) -> List[str]:
             lines.append(f"  {name:<22}{state}{extra}")
     else:
         lines.append("  (no detector transitions recorded)")
+
+    # graftpop per-member health (docs/POPULATION.md): pop<i>_* rows in
+    # the metric stream mean a population > 1 ran — one line per member
+    # joining its newest return/loss/health values and standing alerts
+    pop_ids = sorted({
+        int(k[3:k.index("_")]) for k in series
+        if k.startswith("pop") and "_" in k
+        and k[3:k.index("_")].isdigit()})
+    if pop_ids:
+        lines.append("")
+        lines.append(f"population members ({len(pop_ids)} — newest "
+                     f"value per member)")
+        hdr = (f"{'member':<8}{'return':>12}{'loss':>12}"
+               f"{'q_taken':>12}{'PER ESS':>10}  alerts")
+        lines.append(hdr)
+        lines.append("-" * len(hdr))
+
+        def _newest(key):
+            pts = series.get(key)
+            v = pts[-1][1] if pts else None
+            return v if isinstance(v, (int, float)) else None
+
+        for m in pop_ids:
+            cells = []
+            for key, nd in ((f"pop{m}_return_mean", 2),
+                            (f"pop{m}_loss", 4)):
+                v = _newest(key)
+                cells.append(f"{v:>12,.{nd}f}" if v is not None
+                             else f"{'-':>12}")
+            v = _newest(f"pop{m}_q_taken_mean")
+            cells.append(f"{v:>12,.3f}" if v is not None else f"{'-':>12}")
+            v = _newest(f"pop{m}_sight_per_ess")
+            cells.append(f"{v:>10,.3f}" if v is not None else f"{'-':>10}")
+            standing = sorted(
+                k[len(f"pop{m}_sight_alert_"):]
+                for k, pts in series.items()
+                if k.startswith(f"pop{m}_sight_alert_") and pts
+                and pts[-1][1] not in (0, 0.0))
+            cells.append("  " + (", ".join(standing) or "none"))
+            lines.append(f"pop{m:<5}" + "".join(cells))
 
     curve_keys = []
     for prefix in ("", "test_"):

@@ -1,0 +1,226 @@
+"""graftpop: a vmapped population axis over the whole learner (ROADMAP
+item 5, docs/POPULATION.md).
+
+Podracer's Anakin (PAPERS.md) trains *populations* of agents per chip by
+vmapping the entire agent–learner stack. Our fused superstep is already
+Anakin-shaped — one pure function from ``TrainState`` to ``TrainState`` —
+so the population axis is exactly ``jax.vmap`` over a leading ``(P,)``
+stack of the full train state (params, opt_state, replay ring + PER
+priorities, runner state incl. per-lane EnvParams, RNG keys) plus a
+:class:`PopulationSpec` of per-member hyperparameter scalars. ONE donated
+dispatch then advances P seed/hyperparameter variants
+(``run.Experiment.population_superstep_program``), multiplying experiment
+throughput per chip by P without touching dispatch count.
+
+Per-member knobs enter the math as **vmapped-over scalar leaves**, each a
+neutral operation at its default so the P=1 population is BIT-identical
+to the classic loop (tests/test_population.py):
+
+* ``lr_scale`` — multiplies the optimizer's update tree after
+  ``opt.update`` (learning rate enters optax's adam/rmsprop linearly
+  after the moment statistics, so scaling updates == scaling lr exactly;
+  1.0 multiplies bitwise-identically);
+* ``eps_scale`` — multiplies the epsilon-greedy schedule's epsilon
+  (components/action_selectors.py; 1.0 is bitwise-neutral);
+* ``per_alpha`` — the PER exponent as a traced scalar
+  (components/episode_buffer.py stores ``p^alpha`` at write time; the
+  same ``pow`` on the same values, so the config-default value is
+  value-identical to the static path);
+* ``member`` — the member index, used for per-member scenario
+  decorrelation (``population.scenario_salt`` folds it into the
+  graftworld sampler key, envs/graftworld.py) and per-member logging.
+
+Seed replication is the degenerate case: an empty grid leaves every
+scale at its neutral value and members differ only through their seeds
+(member ``i`` inits from ``seed + i·seed_stride``), so member 0 is
+bit-exactly the solo run at ``cfg.seed``.
+
+Optional PBT (``population.pbt.*``, off by default): host-side
+select-and-perturb on the population axis at checkpoint-save boundaries
+ONLY — the bottom ``frac`` members copy the full train state of the top
+``frac`` (one device gather, zero extra steady-state dispatches) and
+multiplicatively perturb their spec leaves. PBT (and any non-neutral
+grid) deliberately breaks member-0/solo parity — that is its job.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@struct.dataclass
+class PopulationSpec:
+    """Per-member hyperparameters: ``(P,)``-stacked scalar leaves the
+    population superstep vmaps over (module docstring for semantics)."""
+
+    lr_scale: jnp.ndarray      # (P,) f32 — optimizer update multiplier
+    eps_scale: jnp.ndarray     # (P,) f32 — epsilon-schedule multiplier
+    per_alpha: jnp.ndarray     # (P,) f32 — PER priority exponent
+    member: jnp.ndarray        # (P,) int32 — member index (scenario salt)
+
+
+@struct.dataclass
+class PopState:
+    """The checkpointable population state: the ``(P,)``-stacked
+    TrainState plus the (PBT-mutable, therefore checkpointed) spec.
+    ``utils/checkpoint.py`` FORMAT_VERSION 5 lifts a single-member v4
+    checkpoint into this layout (``_migrate_raw``)."""
+
+    ts: object                 # run.TrainState, every leaf (P,)-stacked
+    spec: PopulationSpec
+
+
+def population_size(cfg) -> int:
+    """P when the population axis is on, else 0 (``population.size``;
+    the ``superstep_eligible`` predicate pattern). ``sanity_check`` has
+    already rejected the incompatible combinations (host-RAM replay,
+    dp_devices, sebulba, evaluate/animation)."""
+    return int(cfg.population.size)
+
+
+def member_seeds(cfg) -> List[int]:
+    """Member ``i`` inits and threads keys from ``seed + i·seed_stride``
+    — stride 1 (default) = seed replication with member 0 bit-exactly
+    the solo run; stride 0 = identical seeds (grid-over-knobs mode,
+    usually together with ``scenario_salt``)."""
+    pc = cfg.population
+    return [cfg.seed + i * pc.seed_stride for i in range(pc.size)]
+
+
+def build_spec(cfg) -> PopulationSpec:
+    """The config's per-member grids as a stacked spec. Empty grids
+    replicate the base config's value — ``lr_scale``/``eps_scale`` at
+    exactly 1.0 and ``per_alpha`` at ``replay.per_alpha``, the neutral
+    leaves the P=1 bit-parity contract stands on."""
+    pc = cfg.population
+    p = pc.size
+    lr = pc.lr or (cfg.lr,) * p
+    eps = pc.eps_scale or (1.0,) * p
+    alpha = pc.per_alpha or (cfg.replay.per_alpha,) * p
+    return PopulationSpec(
+        lr_scale=jnp.asarray([v / cfg.lr for v in lr], jnp.float32),
+        eps_scale=jnp.asarray(eps, jnp.float32),
+        per_alpha=jnp.asarray(alpha, jnp.float32),
+        member=jnp.arange(p, dtype=jnp.int32),
+    )
+
+
+def init_population(exp, cfg) -> Tuple[object, PopulationSpec]:
+    """→ (stacked TrainState, spec): P explicit solo inits stacked
+    along the new leading axis — member ``i``'s leaves are BIT-identical
+    to a solo ``init_train_state(seed_i)`` by construction. Deliberately
+    not ``vmap(init)``: batched random/normal lowering drifts a ULP on
+    some leaves (the same f32-reassociation effect the P=1 superstep
+    squeeze path documents), and init runs once — correctness of the
+    seed-replication contract over one-time elegance."""
+    states = [exp.init_train_state(s) for s in member_seeds(cfg)]
+    ts = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    return ts, build_spec(cfg)
+
+
+def member_keys(cfg) -> List[jax.Array]:
+    """The P host-side driver key streams (classic loop convention:
+    ``PRNGKey(seed + 1)`` per member) — the driver mirrors the train
+    gate once (the counters evolve identically across members) and
+    splits EACH member's stream exactly like the classic loop, so
+    member 0's consumed key stream is the solo run's."""
+    return [jax.random.PRNGKey(s + 1) for s in member_seeds(cfg)]
+
+
+# --------------------------------------------------------------------------
+# PBT: host-side select-and-perturb at checkpoint-save boundaries
+# --------------------------------------------------------------------------
+
+
+def pbt_step(cfg, ts, spec: PopulationSpec,
+             member_perf: Optional[List[Optional[float]]], t_env: int
+             ) -> Tuple[object, PopulationSpec, Optional[dict]]:
+    """One exploit/explore pass (``population.pbt.*``): rank members by
+    ``member_perf`` (the stats accumulator's per-member return EMA),
+    copy the bottom ``frac`` members' FULL train state from the top
+    ``frac`` (one device gather — the only extra device work PBT ever
+    does), and multiplicatively perturb the copied members' spec leaves
+    by ``perturb``/``1/perturb``. Returns ``(ts, spec, info|None)``;
+    ``None`` info = no-op (insufficient perf history, or P too small).
+
+    The perturbation RNG derives from ``(seed, t_env)``: two runs
+    reaching the same boundary with the same ranking make identical
+    decisions. The ranking itself (``member_perf`` — the accumulator's
+    return EMA) is HOST state that is deliberately not checkpointed: a
+    restore rebuilds it from fresh flushes, so a restored run may
+    no-op a boundary the original timeline exploited at. That is safe
+    by construction — checkpoints hold the PRE-PBT population, so the
+    restored trajectory is self-consistent; it just re-warms its
+    ranking before exploiting again (docs/POPULATION.md §PBT). Losers
+    keep their OWN driver key streams, and their copied ROLLOUT key
+    (the ``runner.key`` leaf, gathered with the donor's device state)
+    is re-salted with a per-(member, t_env) ``fold_in`` — without the
+    salt an exploited member would replay its donor's exact
+    trajectories (identical scenario draws and exploration) until the
+    differently-sampled train batches pulled the params apart, halving
+    the diversity the exploit step exists to create."""
+    pc = cfg.population.pbt
+    p = cfg.population.size
+    if (member_perf is None or len(member_perf) != p
+            or any(v is None for v in member_perf)):
+        return ts, spec, None
+    n = max(1, int(round(p * pc.frac)))
+    if 2 * n > p:
+        n = p // 2
+    if n < 1:
+        return ts, spec, None
+    order = np.argsort(np.asarray(member_perf, np.float64), kind="stable")
+    losers, winners = order[:n], order[-n:]
+    src = np.arange(p)
+    src[losers] = winners
+    ts = jax.tree.map(lambda x: x[jnp.asarray(src)], ts)
+    runner = getattr(ts, "runner", None)
+    if runner is not None and hasattr(runner, "key"):
+        # re-salt exploited members' rollout key (docstring): the
+        # gather above copied the donor's stream verbatim
+        rkey = runner.key
+        for m in losers:
+            rkey = rkey.at[int(m)].set(jax.random.fold_in(
+                rkey[int(m)], int(t_env) + int(m) + 1))
+        ts = ts.replace(runner=runner.replace(key=rkey))
+    rng = np.random.default_rng((int(cfg.seed) << 17) ^ (int(t_env) + 1))
+    lr = np.asarray(jax.device_get(spec.lr_scale), np.float32).copy()
+    eps = np.asarray(jax.device_get(spec.eps_scale), np.float32).copy()
+    alpha = np.asarray(jax.device_get(spec.per_alpha), np.float32).copy()
+    alpha_pre = alpha.copy()          # donors' pre-perturb exponents
+
+    def _perturb(v):
+        return v * (pc.perturb if rng.random() < 0.5 else 1.0 / pc.perturb)
+
+    for m in losers:
+        lr[m] = _perturb(lr[src[m]])
+        eps[m] = _perturb(eps[src[m]])
+        alpha[m] = float(np.clip(_perturb(alpha[src[m]]), 1e-3, 1.0))
+    buf = getattr(ts, "buffer", None)
+    if buf is not None and hasattr(buf, "priorities"):
+        # the gathered ring stores the DONOR's pre-exponentiated
+        # priorities (p^alpha_donor); the loser's future writes use its
+        # perturbed exponent — rescale the copied entries to
+        # p^alpha_new = (p^alpha_donor)^(alpha_new/alpha_donor) so the
+        # stored-space sampler and IS weights keep one consistent
+        # exponent per member (zeros in the unfilled tail stay zero)
+        pri = buf.priorities
+        for m in losers:
+            a_old = float(alpha_pre[src[m]])
+            a_new = float(alpha[m])
+            if a_old != a_new and a_old > 0:
+                pri = pri.at[int(m)].set(pri[int(m)] ** (a_new / a_old))
+        if pri is not buf.priorities:
+            ts = ts.replace(buffer=buf.replace(priorities=pri))
+    spec = PopulationSpec(
+        lr_scale=jnp.asarray(lr), eps_scale=jnp.asarray(eps),
+        per_alpha=jnp.asarray(alpha), member=spec.member)
+    return ts, spec, {
+        "copied": {int(m): int(src[m]) for m in losers},
+        "perf": [float(v) for v in member_perf],
+    }

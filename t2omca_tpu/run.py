@@ -90,6 +90,20 @@ def _strong(tree):
     return jax.tree.map(lambda x: x.astype(x.dtype), tree)
 
 
+def _squeeze0(tree):
+    """Drop the leading size-1 population axis from every leaf — the
+    P=1 graftpop layout bridge (``population_superstep_program``). Pure
+    layout ops; MUST stay the exact inverse of :func:`_expand0` — the
+    P=1 bit-parity contract stands on both programs using the same
+    bridge."""
+    return jax.tree.map(lambda x: jnp.squeeze(x, 0), tree)
+
+
+def _expand0(tree):
+    """Restore the leading population axis ``_squeeze0`` dropped."""
+    return jax.tree.map(lambda x: x[None], tree)
+
+
 @dataclasses.dataclass
 class Experiment:
     """Built components + jitted programs for one config."""
@@ -339,6 +353,22 @@ class Experiment:
         ``donate=True`` donates the full TrainState — ring, learner and
         runner state update in place across the superstep. Host-RAM
         replay configs are ineligible (``superstep_eligible``)."""
+        return jax.jit(
+            self._superstep_fn(k, constrain_batch, constrain_runner,
+                               constrain_buffer, constrain_learner),
+            donate_argnums=(0,) if donate else ())
+
+    def _superstep_fn(self, k: int, constrain_batch=None,
+                      constrain_runner=None, constrain_buffer=None,
+                      constrain_learner=None):
+        """The unjitted superstep body — shared by the classic jit
+        (``superstep_program``) and the graftpop population vmap
+        (``population_superstep_program``). ``spec`` (an optional
+        graftpop ``PopulationSpec`` of per-member traced scalars)
+        threads the member's epsilon scale into the rollout, its PER
+        exponent into the ring writes, and its lr scale into the
+        learner update; ``None`` (the classic path) compiles the exact
+        pre-population program — every graftprog fingerprint pinned."""
         if self.host_buffer:
             raise ValueError(
                 "superstep_program requires the device-resident replay "
@@ -354,54 +384,134 @@ class Experiment:
         c_learner = constrain_learner or (lambda l: l)
         steps_per_rollout = cfg.batch_size_run * cfg.env_args.episode_limit
 
-        def _train(op):
-            ts, key, t_env = op
-            # identical key/arithmetic threading to _train_iter above
-            k_sample, k_learn = jax.random.split(key)
-            batch, idx, weights = buffer.sample(
-                ts.buffer, k_sample, cfg.batch_size, t_env)
-            learner_state, info = learner.train(
-                ts.learner, constrain(batch), weights, t_env, ts.episode,
-                k_learn)
-            buf = buffer.update_priorities(
-                ts.buffer, idx, info["td_errors_abs"] + 1e-6,      # Q9
-                valid=info["all_finite"])
-            return ts.replace(learner=c_learner(learner_state),
-                              buffer=c_buffer(buf)), _sight_buf(info, buf)
-
-        def _sight_buf(info, buf):
-            # graftsight PER health, in-graph (the shared definition —
-            # see _train_iter). BOTH cond branches route through this
-            # so the info pytrees stay aval-identical (the skip branch
-            # reads the untouched ring)
-            return obs_sight.maybe_buffer_info(cfg, info, buf)
-
-        def _skip(op):
-            ts, _, _ = op
-            return ts, _sight_buf(learner.train_info_zeros(cfg.batch_size),
-                                  ts.buffer)
-
-        def _body(ts: TrainState, xs):
-            key, t_env = xs
-            rs, tm, stats = runner.run_raw(ts.learner.params["agent"],
-                                           ts.runner, test_mode=False)
-            buf = buffer.insert_time_major(ts.buffer, tm)
-            ts = ts.replace(runner=c_runner(rs), buffer=c_buffer(buf),
-                            episode=ts.episode + cfg.batch_size_run)
-            gate = (buffer.can_sample(ts.buffer, cfg.batch_size)
-                    & (ts.episode >= cfg.accumulated_episodes))
-            ts, info = jax.lax.cond(gate, _train, _skip, (ts, key, t_env))
-            return _strong(ts), (stats, _strong(info))
-
         def _superstep(ts: TrainState, keys: jax.Array,
-                       t_env0: jnp.ndarray):
+                       t_env0: jnp.ndarray, spec=None):
+            alpha = None if spec is None else spec.per_alpha
+            roll_kw = {}
+            if spec is not None:
+                roll_kw["eps_scale"] = spec.eps_scale
+                if cfg.population.scenario_salt:
+                    roll_kw["member"] = spec.member
+
+            def _train(op):
+                ts, key, t_env = op
+                # identical key/arithmetic threading to _train_iter above
+                k_sample, k_learn = jax.random.split(key)
+                batch, idx, weights = buffer.sample(
+                    ts.buffer, k_sample, cfg.batch_size, t_env)
+                learner_state, info = learner.train(
+                    ts.learner, constrain(batch), weights, t_env,
+                    ts.episode, k_learn, spec=spec)
+                buf = buffer.update_priorities(
+                    ts.buffer, idx, info["td_errors_abs"] + 1e-6,  # Q9
+                    valid=info["all_finite"], alpha=alpha)
+                return ts.replace(learner=c_learner(learner_state),
+                                  buffer=c_buffer(buf)), _sight_buf(info,
+                                                                    buf)
+
+            def _sight_buf(info, buf):
+                # graftsight PER health, in-graph (the shared definition
+                # — see _train_iter). BOTH cond branches route through
+                # this so the info pytrees stay aval-identical (the skip
+                # branch reads the untouched ring)
+                return obs_sight.maybe_buffer_info(cfg, info, buf)
+
+            def _skip(op):
+                ts, _, _ = op
+                return ts, _sight_buf(
+                    learner.train_info_zeros(cfg.batch_size), ts.buffer)
+
+            def _body(ts: TrainState, xs):
+                key, t_env = xs
+                rs, tm, stats = runner.run_raw(ts.learner.params["agent"],
+                                               ts.runner, test_mode=False,
+                                               **roll_kw)
+                buf = buffer.insert_time_major(ts.buffer, tm, alpha=alpha)
+                ts = ts.replace(runner=c_runner(rs), buffer=c_buffer(buf),
+                                episode=ts.episode + cfg.batch_size_run)
+                gate = (buffer.can_sample(ts.buffer, cfg.batch_size)
+                        & (ts.episode >= cfg.accumulated_episodes))
+                ts, info = jax.lax.cond(gate, _train, _skip,
+                                        (ts, key, t_env))
+                return _strong(ts), (stats, _strong(info))
+
             t_envs = (jnp.asarray(t_env0, jnp.int32)
                       + jnp.arange(1, k + 1, dtype=jnp.int32)
                       * steps_per_rollout)
             ts, (stats, infos) = jax.lax.scan(_body, ts, (keys, t_envs))
             return ts, stats, infos
 
-        return jax.jit(_superstep, donate_argnums=(0,) if donate else ())
+        return _superstep
+
+    def population_superstep_program(self, k: int, donate: bool = False):
+        """→ jitted ``superstep_pop(ts, keys, t_env0, spec) -> (ts',
+        stacked_stats, stacked_infos)`` — graftpop (docs/POPULATION.md):
+        the SAME fused superstep body vmapped over a leading ``(P,)``
+        population axis of the full train state, per-member ``(P, k)``
+        key stacks and the :class:`~t2omca_tpu.population.PopulationSpec`
+        of per-member hyperparameter scalars. ``t_env0`` stays a shared
+        scalar (the counters evolve identically across members). ONE
+        donated dispatch advances all P members; outputs come back with
+        the extra leading ``(P,)`` axis on every stats/info leaf.
+
+        P=1 deliberately bypasses ``jax.vmap``: the member axis is
+        squeezed inside the jit and the UNBATCHED superstep body runs
+        directly (axis-restored on the way out — pure layout ops), so a
+        single-member population lowers the classic program's exact
+        arithmetic and stays BIT-identical to the classic loop. A
+        batched rank would not: XLA's batched reduces reassociate f32
+        sums (data-dependent 1-ULP drift in gradient accumulations —
+        measured on CPU), which is also why P>=2 members pin
+        bit-parity only against EACH OTHER (same batched kernel), not
+        against their solo runs (docs/POPULATION.md §parity). When the
+        P=1 spec is statically NEUTRAL (no grids, no scenario salt, no
+        PBT) the spec seams drop out entirely (``spec=None`` into the
+        body) — even a value-neutral traced seam (``x*1.0``,
+        ``pow(x, traced-default)``) perturbs XLA's fusion choices
+        enough to flip a reduce tiling and drift a ULP (measured), and
+        the bit-parity contract tolerates zero ULPs."""
+
+        fn = self._superstep_fn(k)
+        pc = self.cfg.population
+        p = int(pc.size)
+        neutral = (p == 1 and not pc.lr and not pc.eps_scale
+                   and not pc.per_alpha and not pc.scenario_salt
+                   and not pc.pbt.enabled)
+
+        def _superstep_pop(ts: TrainState, keys: jax.Array,
+                           t_env0: jnp.ndarray, spec):
+            if p == 1:
+                out_ts, stats, infos = fn(
+                    _squeeze0(ts), jnp.squeeze(keys, 0), t_env0,
+                    None if neutral else _squeeze0(spec))
+                return _expand0(out_ts), _expand0(stats), _expand0(infos)
+            return jax.vmap(
+                lambda t, kk, s: fn(t, kk, t_env0, s))(ts, keys, spec)
+
+        return jax.jit(_superstep_pop,
+                       donate_argnums=(0,) if donate else ())
+
+    def population_rollout_program(self):
+        """→ jitted ``pop_test(params, rs) -> (rs', stats)``: the
+        greedy test rollout vmapped over the population axis — serves
+        the test cadence of the population driver loop (the episode
+        batch is dropped inside the jit, so XLA never materializes
+        it). P=1 squeezes instead of vmapping, for the same
+        bit-parity reason as ``population_superstep_program``."""
+        runner = self.runner
+        p = int(self.cfg.population.size)
+
+        def one(params, r):
+            r2, _tm, stats = runner.run_raw(params, r, test_mode=True)
+            return _strong(r2), stats
+
+        def _pop_test(params, rs):
+            if p == 1:
+                r2, stats = one(_squeeze0(params), _squeeze0(rs))
+                return _expand0(r2), _expand0(stats)
+            return jax.vmap(one)(params, rs)
+
+        return jax.jit(_pop_test)
 
 
 def register_audit_programs(ctx):
@@ -443,6 +553,7 @@ def register_audit_programs(ctx):
                         f"(donated TrainState)"),
         **_kernel_pair_programs(key, t_env),
         **_sight_twin_programs(key, t_env),
+        **_population_twin_programs(key, t_env),
     }
 
 
@@ -504,6 +615,37 @@ def _sight_twin_programs(key, t_env):
     }
 
 
+def _population_twin_programs(key, t_env):
+    """The graftpop audit entry (the PR 13/14 twin pattern):
+    ``superstep_pop`` — the SAME fused superstep body vmapped over a
+    FIXED P=2 population at the shared audit scale
+    (``registry.population_audit_config``), ratcheted in programs.json
+    so a population-path cost regression fails the gate statically,
+    while the population-OFF fingerprints of every existing hot program
+    stay byte-identical (the spec seams are ``None``-defaulted — zero
+    re-baseline, pinned by the t1 prelude)."""
+    import jax as _jax
+
+    from .analysis.registry import AuditProgram, population_audit_context
+    pctx = population_audit_context()
+    exp, k = pctx.exp, pctx.superstep_k
+    p = pctx.cfg.population.size
+    # the context's ts_shape IS the stacked (ts, spec) aval pair —
+    # registry.population_audit_context docstring
+    ts_shape, spec_shape = pctx.ts_shape
+    prog = exp.population_superstep_program(k, donate=True)
+    keys = _jax.ShapeDtypeStruct((p, k) + key.shape, key.dtype)
+    return {
+        "superstep_pop": AuditProgram(
+            prog, (ts_shape, keys, t_env, spec_shape),
+            donate_argnums=(0,),
+            description=f"fused K={k} superstep vmapped over a P={p} "
+                        f"population (graftpop — one donated dispatch "
+                        f"advances P members; per-member lr/eps/alpha "
+                        f"spec leaves)"),
+    }
+
+
 def run(cfg: TrainConfig, logger: Optional[Logger] = None) -> TrainState:
     """Top-level entry (reference ``run``, ``per_run.py:20-66``): set up the
     unique token and sinks, then train (or evaluate and exit)."""
@@ -555,6 +697,19 @@ def run_sequential(exp: Experiment, logger: Logger,
     env_info = exp.env.get_env_info()
     log.info(f"env_info: {env_info}")
 
+    # ---- graftpop population axis (docs/POPULATION.md) -----------------
+    # P > 0 vmaps the WHOLE train state over a leading (P,) axis and
+    # drives the loop through ONE donated population superstep per
+    # iteration — P seed/hyperparameter variants per dispatch. P = 0
+    # (default) leaves every program and this loop byte-identical.
+    from . import population as graftpop
+    P = graftpop.population_size(cfg)
+    spec = graftpop.build_spec(cfg) if P else None
+    if P:
+        log.info(f"graftpop: population of {P} members per dispatch "
+                 f"(seeds {graftpop.member_seeds(cfg)}, "
+                 f"pbt={'on' if cfg.population.pbt.enabled else 'off'})")
+
     # ---- graftpulse live telemetry plane (docs/OBSERVABILITY.md §pulse)
     # obs.pulse_port unset (default) leaves all three as no-op/None —
     # the loop below is byte-identical to a build without the plane
@@ -569,8 +724,11 @@ def run_sequential(exp: Experiment, logger: Logger,
     # graftsight learning-health monitor (docs/OBSERVABILITY.md §6):
     # None when obs.sight is off — the loop below is byte-identical.
     # The in-graph half already rode the train programs; this is the
-    # host detector pass over the log-cadence fetch.
-    sight_mon = obs_sight.make_monitor(cfg.obs, logger=logger, rec=rec)
+    # host detector pass over the log-cadence fetch. Under a population
+    # the detectors run PER MEMBER over the (P,)-leading fetched leaves
+    # and the /healthz verdicts name pop<i> (sight.PopulationSightMonitor).
+    sight_mon = obs_sight.make_monitor(cfg.obs, logger=logger, rec=rec,
+                                       population=P)
 
     def _persist_flight(path: str) -> None:
         """Flight persist + the memwatch high-water + sight-verdict
@@ -615,35 +773,80 @@ def run_sequential(exp: Experiment, logger: Logger,
         ts = load_checkpoint_sharded(found[0], shapes,
                                      dp.state_shardings(shapes),
                                      verify=False)
+    elif P and found is None:
+        # population init: P explicit solo inits stacked — member i's
+        # leaves are bit-identical to a solo init at seed_i
+        ts, spec = graftpop.init_population(exp, cfg)
+    elif P:
+        # population RESUME: an abstract template only — P concrete
+        # inits here would materialize P replay rings just to be
+        # discarded by the load below (the ADVICE-r5 init-then-load
+        # transient, ×P). The spec stays concrete: a single-member
+        # (v4) checkpoint lifting into this template takes its spec
+        # from HERE (the config's grids), not from zero-filled avals.
+        ts = jax.eval_shape(lambda: graftpop.init_population(exp, cfg))[0]
+        spec = graftpop.build_spec(cfg)
     else:
         ts = exp.init_train_state(cfg.seed)
     # the driver loop replaces its state right after every call, so the
     # replay ring / train state can be donated (in-place on device)
     rollout, insert, train_iter = (dp or exp).jitted_programs(donate=True)
+
     # fused superstep (config.superstep, docs/SPEC.md §8): K > 1 swaps the
     # three-program iteration for ONE donated program scanning K rollout→
     # insert→train iterations per dispatch; the rollout program above
-    # still serves the test/animation cadences
+    # still serves the test/animation cadences. A population ALWAYS
+    # drives through the (vmapped) fused program, even at K=1 — one
+    # donated dispatch advances all P members. The builder is shared
+    # with the degradation ladder's K→1 rung.
+    def _build_superstep(k):
+        if P:
+            return exp.population_superstep_program(k, donate=True)
+        return (dp or exp).superstep_program(k, donate=True)
+
     K = cfg.superstep if superstep_eligible(cfg) else 1
-    superstep = ((dp or exp).superstep_program(K, donate=True)
-                 if K > 1 else None)
+    pop_test = None
+    if P:
+        K = max(cfg.superstep, 1)
+        superstep = _build_superstep(K)
+        pop_test = exp.population_rollout_program()
+        log.info(f"population superstep: {P} members x {K} iterations "
+                 f"per dispatch")
+    else:
+        superstep = _build_superstep(K) if K > 1 else None
     if cfg.superstep > 1 and K == 1:
         log.info("superstep requested but ineligible (buffer_cpu_only "
                  "keeps the three-program path)")
-    elif K > 1:
+    elif K > 1 and not P:
         log.info(f"fused superstep: {K} iterations per dispatch")
-    key = jax.random.PRNGKey(cfg.seed + 1)
+    # per-member driver key streams under a population (each member's
+    # stream splits exactly like the classic loop's single one)
+    key = graftpop.member_keys(cfg) if P else jax.random.PRNGKey(
+        cfg.seed + 1)
+
+    def _ckpt_state():
+        """What checkpoints hold: the bare TrainState classically, the
+        (state, spec) PopState under a population (the spec is
+        PBT-mutable and must resume with the members it shaped)."""
+        return graftpop.PopState(ts=ts, spec=spec) if P else ts
 
     t_env = 0
     # ---- resume (reference :159-189, Q13: t_env cursor restored) ----
     if found is not None:
         dirname, step = found
-        if dp is None:
+        if P:
+            # population resume: the checkpoint is a PopState (or a
+            # v4 single-member state the migration shim lifts to
+            # P=stacked — utils/checkpoint._migrate_raw)
+            ps = load_checkpoint(dirname, _ckpt_state(), verify=False)
+            ts, spec = ps.ts, ps.spec
+        elif dp is None:
             # find_checkpoint already hashed this candidate — skip
             # re-verify (the DP path restored sharded above)
             ts = load_checkpoint(dirname, ts, verify=False)
         t_env = step
-        new_t = jnp.asarray(step, jnp.int32)
+        new_t = (jnp.full((P,), step, jnp.int32) if P
+                 else jnp.asarray(step, jnp.int32))
         if dp is not None:
             # keep the canonical replicated placement — a fresh
             # single-device scalar here would hand the first dispatch a
@@ -794,6 +997,13 @@ def run_sequential(exp: Experiment, logger: Logger,
         in the span event (attempt counts, K); the watchdog stamp is
         the OUTER context so a hang inside the span bookkeeping is
         still bounded."""
+        if P and state is not None and not hasattr(state, "spec"):
+            # population runs stamp the CHECKPOINTABLE PopState, never
+            # the bare stacked TrainState: the watchdog's emergency
+            # save writes the stamped state verbatim, and a bare
+            # stacked tree would hit the single-member→population
+            # migration shim on restore and double-stack
+            state = graftpop.PopState(ts=state, spec=spec)
         w = (wd.watch(phase, t_env=t_env, state=state)
              if wd is not None else None)
         if rec.enabled:
@@ -810,11 +1020,15 @@ def run_sequential(exp: Experiment, logger: Logger,
     # log a wildly-low throughput outlier
     start_t = last_T = t_env
     n_test_runs = max(1, cfg.test_nepisode // cfg.batch_size_run)
-    test_quota = n_test_runs * cfg.batch_size_run      # Q10 rounded quota
+    # Q10 rounded quota; a population tests all P members per dispatch,
+    # so the accumulator's total-episode quota scales by P
+    test_quota = n_test_runs * cfg.batch_size_run * max(P, 1)
     train_infos = []
-    # terminal-info stat accumulation (reference parallel_runner.py:202-231)
-    train_acc = StatsAccumulator()
-    test_acc = StatsAccumulator()
+    # terminal-info stat accumulation (reference parallel_runner.py:202-231;
+    # population=P adds the per-member pop<i>_* aggregation on the same
+    # fold fetch — utils/stats.py)
+    train_acc = StatsAccumulator(population=P)
+    test_acc = StatsAccumulator(population=P)
     last_runner_log_t = t_env
     # in-training animation cadence (reference per_run.py:258-263)
     last_anim_t = -cfg.animation_interval - 1
@@ -842,7 +1056,7 @@ def run_sequential(exp: Experiment, logger: Logger,
                  batch_size_run=cfg.batch_size_run,
                  episode_limit=cfg.env_args.episode_limit,
                  batch_size=cfg.batch_size, superstep=K,
-                 host_buffer=exp.host_buffer,
+                 host_buffer=exp.host_buffer, population=P,
                  scenario=scenario_config(cfg.env_args).kind)
     # per-stage barriers for honest attribution; tracing implies them
     # (an un-synced trace window would capture dispatch, not execution)
@@ -860,9 +1074,17 @@ def run_sequential(exp: Experiment, logger: Logger,
     # The loop then only blocks at its natural cadences (stat flush, log,
     # test, checkpoint), letting the host enqueue ahead of the device.
     steps_per_rollout = cfg.batch_size_run * cfg.env_args.episode_limit
-    episode = int(jax.device_get(ts.episode))          # restored on resume
+
+    def _host_int(x) -> int:
+        """Host mirror of a control counter. Under a population the
+        counter is (P,)-stacked but every member's copy evolves
+        identically (same batch_size_run, capacity, gates), so member
+        0's value mirrors the whole stacked pytree."""
+        return int(np.asarray(jax.device_get(x)).reshape(-1)[0])
+
+    episode = _host_int(ts.episode)                    # restored on resume
     buffer_filled = (0 if exp.host_buffer else
-                     int(jax.device_get(ts.buffer.episodes_in_buffer)))
+                     _host_int(ts.buffer.episodes_in_buffer))
     buffer_capacity = 0 if exp.host_buffer else exp.buffer.capacity
     inflight = deque()              # rollout outputs not yet waited on
 
@@ -916,8 +1138,14 @@ def run_sequential(exp: Experiment, logger: Logger,
         the degradation ladder's restore rung."""
         nonlocal ts, t_env, episode, buffer_filled, train_infos
         nonlocal last_test_t, last_log_t, last_runner_log_t, last_save_t
-        nonlocal nonfinite_streak, train_acc
-        if dp is not None:
+        nonlocal nonfinite_streak, train_acc, spec
+        if P:
+            # population restore: the checkpoint holds a PopState; the
+            # live ts only contributes structure/shape metadata
+            ps = load_checkpoint(dirname, _ckpt_state(), verify=False)
+            ts, spec = ps.ts, ps.spec
+            new_t = jnp.full((P,), step, jnp.int32)
+        elif dp is not None:
             # same born-sharded restore as the resume path: the live ts
             # only contributes shape metadata (its donated leaves may
             # already be deleted), and the single-device load → shard
@@ -935,10 +1163,9 @@ def run_sequential(exp: Experiment, logger: Logger,
         ts = ts.replace(runner=ts.runner.replace(t_env=new_t))
         # re-sync every host-side mirror of device state
         t_env = step
-        episode = int(jax.device_get(ts.episode))
+        episode = _host_int(ts.episode)
         if not exp.host_buffer:
-            buffer_filled = int(jax.device_get(
-                ts.buffer.episodes_in_buffer))
+            buffer_filled = _host_int(ts.buffer.episodes_in_buffer)
         inflight.clear()
         train_infos = []
         # the restored state predates whatever streak was counted — a
@@ -953,7 +1180,7 @@ def run_sequential(exp: Experiment, logger: Logger,
         # the reset: stat_fetches is logged as a cumulative round-trip
         # counter and must not go backwards across a restore
         fetches = train_acc.fetches
-        train_acc = StatsAccumulator()
+        train_acc = StatsAccumulator(population=P)
         train_acc.fetches = fetches
         if exp.host_buffer:
             # same hazard for the host-replay deferred priority refs:
@@ -991,7 +1218,10 @@ def run_sequential(exp: Experiment, logger: Logger,
             log.warning(f"degradation ladder: {df} — falling back "
                         f"superstep K={K} -> 1 ({ladder.describe()})")
             K = 1
-            superstep = None
+            # a population still drives through the (vmapped) fused
+            # program — rebuild it at K=1 instead of dropping to the
+            # three-program path, which has no population rank
+            superstep = _build_superstep(1) if P else None
             logger.log_stat("superstep_k", 1, t_env)
             return
         if action == "restore":
@@ -1064,7 +1294,7 @@ def run_sequential(exp: Experiment, logger: Logger,
                 # endpoint): one os.path.exists when idle
                 trc.poll(t_env)
             tracer.maybe_start(t_env)
-            if K > 1:
+            if superstep is not None:
                 # ------------ fused superstep (one dispatch = K iters) ------
                 # mirror the control scalars host-side for each of the K
                 # sub-iterations: they evolve deterministically (see the
@@ -1077,7 +1307,14 @@ def run_sequential(exp: Experiment, logger: Logger,
                 # dispatch succeeds: an in-place retry (or a ladder rung
                 # abandoning this dispatch) replays the identical key
                 # stream, preserving bit-parity with the K=1 loop.
-                key2, ep2, fill2 = key, episode, buffer_filled
+                # Under a population (P > 0) `key` is a LIST of P member
+                # streams: the gate mirror is computed ONCE (the
+                # counters evolve identically across members) and each
+                # member's stream splits exactly like the classic
+                # loop's single one — member 0's consumed stream IS the
+                # solo run's, the bit-parity contract.
+                ep2, fill2 = episode, buffer_filled
+                key2 = list(key) if P else key
                 key_rows, gated = [], []
                 for _ in range(K):
                     ep2 += cfg.batch_size_run
@@ -1086,21 +1323,38 @@ def run_sequential(exp: Experiment, logger: Logger,
                     g = (fill2 >= cfg.batch_size
                          and ep2 >= cfg.accumulated_episodes)
                     gated.append(g)
-                    if g:
+                    if P:
+                        if g:
+                            row = []
+                            for m in range(P):
+                                key2[m], k_s = jax.random.split(key2[m])
+                                row.append(k_s)
+                            key_rows.append(jnp.stack(row))
+                        else:
+                            key_rows.append(jnp.zeros(
+                                (P,) + key2[0].shape, key2[0].dtype))
+                    elif g:
                         key2, k_sample = jax.random.split(key2)
                         key_rows.append(k_sample)
                     else:
                         key_rows.append(jnp.zeros_like(key2))
                 def _fused(ts=ts, key_rows=key_rows):
-                    ts, stats, infos = superstep(ts, jnp.stack(key_rows),
-                                                 jnp.asarray(t_env))
+                    if P:
+                        # (P, K, 2) — the vmapped program maps axis 0,
+                        # each member scanning its own (K,) key rows
+                        ts2, stats, infos = superstep(
+                            ts, jnp.stack(key_rows, axis=1),
+                            jnp.asarray(t_env), spec)
+                    else:
+                        ts2, stats, infos = superstep(
+                            ts, jnp.stack(key_rows), jnp.asarray(t_env))
                     if sync_stages:
                         # inside the dispatched fn so the barrier (where
                         # a device-side wedge actually surfaces) is
                         # covered by the watchdog stamp + retry, like
                         # _roll/_train_once below
                         jax.block_until_ready(stats.epsilon)
-                    return ts, stats, infos
+                    return ts2, stats, infos
                 try:
                     with timer.stage("superstep"):
                         ts, stats, infos = _dispatch("dispatch.superstep",
@@ -1112,8 +1366,11 @@ def run_sequential(exp: Experiment, logger: Logger,
                 t_env += K * steps_per_rollout
                 for i, g in enumerate(gated):
                     if g:
-                        train_infos.append(
-                            jax.tree.map(lambda x, i=i: x[i], infos))
+                        # population infos carry the leading (P,) member
+                        # axis; the scan's (K,) axis is the next one
+                        train_infos.append(jax.tree.map(
+                            (lambda x, i=i: x[:, i]) if P
+                            else (lambda x, i=i: x[i]), infos))
             else:
                 # ------------ rollout (no grad by construction) -------------
                 def _roll(ts=ts):
@@ -1234,6 +1491,14 @@ def run_sequential(exp: Experiment, logger: Logger,
                             # would overrun a per-dispatch-sized timeout
                             # on a perfectly healthy test cadence
                             def _test_roll(ts=ts):
+                                if P:
+                                    # vmapped greedy rollout: every
+                                    # member evaluates in the SAME
+                                    # dispatch (the population cost
+                                    # profile — never P fetches)
+                                    return pop_test(
+                                        ts.learner.params["agent"],
+                                        ts.runner)
                                 rs, _, s = rollout(
                                     ts.learner.params["agent"], ts.runner,
                                     test_mode=True)
@@ -1260,7 +1525,7 @@ def run_sequential(exp: Experiment, logger: Logger,
                     # accumulation would miss the exact-quota flush on
                     # every later cadence; degrading can't help a test
                     # rollout, only restore can
-                    test_acc = StatsAccumulator()
+                    test_acc = StatsAccumulator(population=P)
                     _dispatch_ladder(df, can_degrade=False)
                     continue
                 last_test_t = t_env
@@ -1298,8 +1563,10 @@ def run_sequential(exp: Experiment, logger: Logger,
                         if not _acquire_save_lock("save cadence"):
                             return None
                         try:
+                            # population checkpoints hold the PopState
+                            # (stacked state + the PBT-mutable spec)
                             return save_checkpoint(
-                                model_dir, t_env, ts,
+                                model_dir, t_env, _ckpt_state(),
                                 gather_retries=res.dispatch_retries,
                                 gather_backoff_s=res.retry_backoff_s)
                         finally:
@@ -1330,6 +1597,30 @@ def run_sequential(exp: Experiment, logger: Logger,
                     # instead of silently widening the data-loss window
                     # by a full save interval right after a stall event
                     last_save_t = t_env
+                    if P and cfg.population.pbt.enabled:
+                        # PBT exploit/explore (docs/POPULATION.md): at
+                        # save boundaries ONLY, after the save — the
+                        # published checkpoint holds the pre-PBT
+                        # population, so a restored run is self-
+                        # consistent (it re-warms the host-side
+                        # ranking EMA from fresh flushes and may no-op
+                        # this boundary rather than replay it — the
+                        # EMA is deliberately not checkpointed). The
+                        # ranking signal is the accumulator's
+                        # per-member return EMA (riding the existing
+                        # fold fetch — the only device work here is
+                        # pbt_step's one gather when members copy).
+                        ts, spec, pbt_info = graftpop.pbt_step(
+                            cfg, ts, spec,
+                            train_acc.member_return_ema, t_env)
+                        if pbt_info is not None:
+                            logger.log_stat("pbt_copies",
+                                            len(pbt_info["copied"]),
+                                            t_env)
+                            rec.mark("pbt", t_env=t_env, **pbt_info)
+                            log.info(f"graftpop PBT: exploited "
+                                     f"{pbt_info['copied']} at "
+                                     f"t_env={t_env}")
 
             # ---------------- log cadence (reference :283-286) ------------------
             if (t_env - last_log_t) >= cfg.log_interval:
@@ -1355,6 +1646,14 @@ def run_sequential(exp: Experiment, logger: Logger,
                     except watchdog.DispatchFailed as df:
                         _dispatch_ladder(df, can_degrade=False)
                         continue
+                    if P:
+                        # (n, P) member flags: a train step counts as
+                        # finite only when EVERY member's update was —
+                        # one poisoned member is a restore-worthy event
+                        # exactly like a solo NaN (the stacked state is
+                        # one checkpoint)
+                        flags = flags.reshape(len(train_infos), -1)\
+                                     .all(axis=1)
                     for ok in flags:
                         if ok:
                             nonfinite_streak = 0
@@ -1380,7 +1679,18 @@ def run_sequential(exp: Experiment, logger: Logger,
                             f"were skipped")
                     for k in ("loss", "grad_norm", "td_error_abs",
                               "q_taken_mean", "target_mean"):
-                        logger.log_stat(k, float(last[k]), t_env)
+                        if P:
+                            # aggregate row = population mean; per-
+                            # member rows (pop<i>_*) only at P > 1 so a
+                            # P=1 run keeps the solo metric stream
+                            v = np.asarray(last[k], np.float64)
+                            logger.log_stat(k, float(v.mean()), t_env)
+                            if P > 1:
+                                for m in range(P):
+                                    logger.log_stat(f"pop{m}_{k}",
+                                                    float(v[m]), t_env)
+                        else:
+                            logger.log_stat(k, float(last[k]), t_env)
                     if sight_mon is not None:
                         # graftsight detector pass over the SAME fetched
                         # info (no extra device traffic; the monitor
@@ -1409,8 +1719,8 @@ def run_sequential(exp: Experiment, logger: Logger,
                                 f"training diverged: {nonfinite_streak} "
                                 f"consecutive non-finite train steps at "
                                 f"t_env={t_env} (last loss="
-                                f"{float(last['loss'])}, grad_norm="
-                                f"{float(last['grad_norm'])}), and "
+                                f"{float(np.mean(last['loss']))}, grad_norm="
+                                f"{float(np.mean(last['grad_norm']))}), and "
                                 + (f"restore limit reached (resilience."
                                    f"max_restores={res.max_restores})"
                                    if found is not None else
@@ -1534,7 +1844,7 @@ def run_sequential(exp: Experiment, logger: Logger,
                     with deadline:
                         save_to = watchdog.retry_call(
                             lambda: save_checkpoint(
-                                model_dir, t_env, ts,
+                                model_dir, t_env, _ckpt_state(),
                                 gather_retries=res.dispatch_retries,
                                 gather_backoff_s=res.retry_backoff_s),
                             attempts=(1 + res.dispatch_retries

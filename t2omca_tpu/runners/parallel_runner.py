@@ -129,14 +129,23 @@ class ParallelRunner:
         structure; built on demand (cheap: pure dataclass assembly)."""
         return make_scenario_distribution(self.cfg.env_args)
 
-    def _sample_scenarios(self, key: jax.Array) -> EnvParams:
+    def _sample_scenarios(self, key: jax.Array,
+                          member=None) -> EnvParams:
         """One EnvParams instance per lane, from a ``fold_in`` side key
         (see ``_SCENARIO_SALT``): each lane draws its own scenario with
         zero extra dispatches — the sampling is part of the rollout
-        program."""
+        program. ``member`` (a traced graftpop member index, only under
+        ``population.scenario_salt``) folds a per-member salt into the
+        sampler key so vmapped members draw different scenario
+        instances from the same distribution
+        (envs/graftworld.member_scenario_key); ``None`` keeps the
+        pre-population key chain bit-identical."""
         scn = self.scenario
-        keys = jax.random.split(
-            jax.random.fold_in(key, _SCENARIO_SALT), self.batch_size)
+        k = jax.random.fold_in(key, _SCENARIO_SALT)
+        if member is not None:
+            from ..envs.graftworld import member_scenario_key
+            k = member_scenario_key(k, member)
+        keys = jax.random.split(k, self.batch_size)
         return jax.vmap(lambda k: scn.sample(k, self.env))(keys)
 
     # ------------------------------------------------------------------ state
@@ -162,7 +171,7 @@ class ParallelRunner:
     # ------------------------------------------------------------------ rollout
 
     def run(self, params, rs: RunnerState, test_mode: bool = False,
-            capture: bool = False):
+            capture: bool = False, eps_scale=None, member=None):
         """One synchronous batched episode. Pure → jittable; ``test_mode``
         (greedy selection) and ``capture`` are static Python bools.
 
@@ -170,7 +179,8 @@ class ParallelRunner:
         visualization fields (pre-step AGV positions, serving MECs, ACKs) as
         ``(T, B, ...)`` arrays — the same scan emits them, so the trajectory
         is exactly the episode in the returned batch (no re-run, no drift)."""
-        out = self.run_raw(params, rs, test_mode=test_mode, capture=capture)
+        out = self.run_raw(params, rs, test_mode=test_mode, capture=capture,
+                           eps_scale=eps_scale, member=member)
         if capture:
             new_rs, tm, stats, viz = out
             return new_rs, tm.to_batch(), stats, viz
@@ -178,13 +188,19 @@ class ParallelRunner:
         return new_rs, tm.to_batch(), stats
 
     def run_raw(self, params, rs: RunnerState, test_mode: bool = False,
-                capture: bool = False):
+                capture: bool = False, eps_scale=None, member=None):
         """``run`` minus the episode-batch assembly: returns the scan's
         time-major emission (``TimeMajorEpisodes``) so the fused superstep
         can scatter it straight into the replay ring without ever
         materializing the ``(B, T+1, ...)`` batch. ``run`` itself is
         ``run_raw`` + ``to_batch()`` — one rollout definition for both
-        paths."""
+        paths.
+
+        ``eps_scale``/``member`` are the graftpop per-member seams
+        (traced scalars from the PopulationSpec the population
+        superstep vmaps over): the epsilon-schedule multiplier and the
+        scenario-sampler member salt. ``None`` defaults keep every
+        pre-population caller's program byte-identical."""
         b, t_len = self.batch_size, self.env.cfg.episode_limit
         key, k_reset, k_scan = jax.random.split(rs.key, 3)
         # qslice weight folds are loop-invariant: do them once per rollout,
@@ -196,7 +212,7 @@ class ParallelRunner:
         # whole distribution — fixed/uniform/mixture alike). The sampler
         # key folds off rs.key so the env/action key streams are
         # untouched (bit-parity at the fixed default scenario)
-        env_params = self._sample_scenarios(rs.key)
+        env_params = self._sample_scenarios(rs.key, member=member)
 
         # reset every lane, carrying each lane's Welford normalizer (Q4)
         reset_keys = jax.random.split(k_reset, b)
@@ -244,7 +260,7 @@ class ParallelRunner:
                        else None)
             actions, hidden, eps = self.mac.select_actions(
                 params, obs, avail, hidden, k_act, t_env,
-                test_mode=test_mode, compact=compact)
+                test_mode=test_mode, compact=compact, eps_scale=eps_scale)
             # Q15: the action is recorded with the pre-step observation.
             # Cast to the storage dtype here so the scan stacks the compact
             # representation (the f32 episode stack is the HBM hot spot);

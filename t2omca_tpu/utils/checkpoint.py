@@ -43,11 +43,18 @@ logger = logging.getLogger(__name__)
 #: bump when the checkpointed pytree layout changes incompatibly
 #: (v2: bool avail storage + meta sidecar; v3: RunnerState carries the
 #: per-lane reward-scale state; v4: RunnerState carries the per-lane
-#: graftworld scenario params, envs/mec_offload.EnvParams). The
-#: staged/atomic write and the sidecar's ``sha256``/``bytes`` keys are
-#: ADDITIVE — the tree layout is unchanged and old readers ignore
-#: unknown sidecar keys, so they do not bump this.
-FORMAT_VERSION = 4
+#: graftworld scenario params, envs/mec_offload.EnvParams; v5: graftpop
+#: population runs checkpoint a ``population.PopState`` — the
+#: (P,)-stacked TrainState plus the PBT-mutable PopulationSpec —
+#: instead of the bare TrainState; classic runs keep the bare-TrainState
+#: layout AND keep stamping v4 (``_state_format``) so a pre-population
+#: build can still restore them after a rollback, and a single-member
+#: checkpoint restores into a population
+#: template via the ``_lift_population`` shim). The staged/atomic write
+#: and the sidecar's ``sha256``/``bytes`` keys are ADDITIVE — the tree
+#: layout is unchanged and old readers ignore unknown sidecar keys, so
+#: they do not bump this.
+FORMAT_VERSION = 5
 
 
 class CheckpointFormatError(ValueError):
@@ -63,9 +70,22 @@ class CheckpointIntegrityError(RuntimeError):
     themselves are bad."""
 
 
+def _state_format(state: Any) -> int:
+    """The format version THIS state's layout needs: v5 only for a
+    graftpop ``PopState`` (the new-in-v5 layout); classic bare-TrainState
+    checkpoints keep stamping v4 — their on-disk layout is unchanged, so
+    a pre-population build (whose ``FORMAT_VERSION`` is 4) must keep
+    restoring them after a rollback."""
+    return (FORMAT_VERSION
+            if hasattr(state, "ts") and hasattr(state, "spec") else 4)
+
+
 def _obs_layout(state: Any) -> Optional[str]:
     """'compact' | 'dense' | None (host buffer keeps state outside the tree)."""
     from ..components.episode_buffer import CompactEntityObs
+    # a graftpop PopState wraps the (stacked) TrainState in `.ts`; the
+    # storage layout is a per-leaf property, unchanged by the stack
+    state = getattr(state, "ts", state)
     buf = getattr(state, "buffer", None)
     if buf is None:
         return None
@@ -217,7 +237,8 @@ def save_checkpoint(path: str, t_env: int, state: Any,
     # write and the publish — the whole point of the staged layout
     resilience.fire("checkpoint.staged", dirname=staging, t_env=int(t_env))
     with open(os.path.join(staging, "meta.json"), "w") as f:
-        json.dump({"format": FORMAT_VERSION, "obs_layout": _obs_layout(state),
+        json.dump({"format": _state_format(state),
+                   "obs_layout": _obs_layout(state),
                    "t_env": int(t_env), "sha256": digest,
                    "bytes": os.path.getsize(state_path)}, f)
         f.flush()
@@ -431,6 +452,51 @@ def _inject_runner_field(raw: Any, target: Any, name: str) -> None:
     raw["runner"][name] = serialization.to_state_dict(host)
 
 
+def _lift_population(raw: Any, target: Any) -> Any:
+    """v4 → v5 graftpop shim: lift a SINGLE-MEMBER checkpoint (the bare
+    TrainState state-dict every pre-population run wrote) into a
+    population template — every member starts from the same restored
+    state, replicated along the new leading ``(P,)`` axis, and the spec
+    comes from the template (the caller's config-built grids; zeros on
+    an eval_shape template). Lossless: member 0 IS the restored run.
+    Keyed on STRUCTURE, not version: any single-member tree (missing
+    the ``spec`` key) restoring into a ``PopState`` template lifts.
+
+    Members 1..P-1 get their replicated ROLLOUT key (``runner.key``)
+    re-salted with a per-member ``fold_in`` — the self-evolving env/
+    exploration/scenario stream lives in that leaf, and a verbatim
+    replica would make every member draw the SAME trajectories for the
+    rest of the run, silently defeating the population's diversity
+    (the same defect class pbt_step re-salts exploited members for).
+    Member 0's key is untouched: member 0 IS the restored run."""
+    import numpy as _np
+    p = int(jax.tree_util.tree_leaves(target.spec)[0].shape[0])
+
+    def _stack(a):
+        # read-only stride-0 broadcast VIEW, deliberately not .copy():
+        # the lift runs on the full host state-dict (replay ring
+        # included), and P materialized host copies of a multi-GiB ring
+        # would OOM the resume this shim exists to enable — the P-times
+        # footprint is inherent on DEVICE, the host transient is not
+        # (from_state_dict/device_put copy per leaf on transfer anyway)
+        a = _np.asarray(a)
+        return _np.broadcast_to(a, (p,) + a.shape)
+
+    spec_host = jax.tree.map(
+        lambda x: (_np.zeros(x.shape, x.dtype)
+                   if isinstance(x, jax.ShapeDtypeStruct)
+                   else _np.asarray(jax.device_get(x))), target.spec)
+    stacked = jax.tree.map(_stack, raw)
+    runner = stacked.get("runner") if isinstance(stacked, dict) else None
+    if isinstance(runner, dict) and "key" in runner:
+        k = _np.asarray(runner["key"])
+        runner["key"] = _np.stack(
+            [k[0]] + [_np.asarray(jax.device_get(
+                jax.random.fold_in(jax.numpy.asarray(k[m]), m)))
+                for m in range(1, p)])
+    return {"ts": stacked, "spec": serialization.to_state_dict(spec_host)}
+
+
 def _migrate_raw(meta: Optional[dict], raw: Any, target: Any) -> Any:
     """Stepwise format migrations, each lossless:
 
@@ -444,16 +510,32 @@ def _migrate_raw(meta: Optional[dict], raw: Any, target: Any) -> Any:
       values (the caller's freshly-initialized scenario draw; zeros on
       an eval_shape template) are consumed by nothing — a v3 run
       restores into the v4 tree with identical training behavior.
+    * v4 → v5 wrapped population runs' state in ``population.PopState``
+      (``_lift_population`` above): a single-member checkpoint restores
+      into a population template with every member replicated from it.
 
     Meta-less checkpoints (pre-v2, or a deleted sidecar) take the same
     path: injection is conditional on the field actually being absent,
     so a current-format tree without its meta.json still restores
     unmodified."""
     fmt = meta.get("format", 0) if meta is not None else 0
+    pop_target = (hasattr(target, "ts") and hasattr(target, "spec")
+                  and isinstance(raw, dict) and "spec" not in raw)
+    if pop_target:
+        # the earlier stepwise injections below run against a
+        # SINGLE-member view of the stacked template (strip the (P,)
+        # axis): the raw tree is still single-member at this point
+        inject_target = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype),
+            target.ts)
+    else:
+        inject_target = target
     if fmt < 3:
-        _inject_runner_field(raw, target, "rscale")
+        _inject_runner_field(raw, inject_target, "rscale")
     if fmt < 4:
-        _inject_runner_field(raw, target, "env_params")
+        _inject_runner_field(raw, inject_target, "env_params")
+    if pop_target:
+        raw = _lift_population(raw, target)
     return raw
 
 

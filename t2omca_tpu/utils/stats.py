@@ -42,6 +42,14 @@ TERMINAL_INFO_KEYS = (
 SLICE_KEYS = ("conflict_ratio", "task_completion_rate",
               "deadline_miss_rate")
 
+#: per-member keys emitted under the graftpop ``pop<i>_*`` rows
+#: (docs/POPULATION.md): the experiment-comparison metrics — per-member
+#: return rides separately as ``pop<i>_return_mean``. Same restraint as
+#: SLICE_KEYS: the full TERMINAL set × P would flood the stream with
+#: rows that cannot differ usefully by member.
+POP_MEMBER_KEYS = ("task_completion_rate", "conflict_ratio",
+                   "deadline_miss_rate")
+
 
 class StatsAccumulator:
     """Accumulates RolloutStats across rollouts; flush = reference ``_log``.
@@ -60,18 +68,32 @@ class StatsAccumulator:
     #: FOLD_EVERY rollouts — negligible against the interval it bounds)
     FOLD_EVERY = 64
 
-    def __init__(self):
+    def __init__(self, population: int = 0):
         self.n_episodes = 0
         #: device→host round-trips this accumulator has performed
         #: (folds + mid-interval epsilon reads) — graftscope surfaces it
         #: as ``stat_fetches`` so sync-point cost is attributable from
         #: telemetry alone (each fetch is ~0.66 s under the axon tunnel)
         self.fetches = 0
+        #: graftpop population axis (docs/POPULATION.md): P > 0 means
+        #: every pushed stats leaf carries a LEADING (P,) member axis
+        #: (the population superstep's vmapped output). The fold then
+        #: ALSO aggregates per member — riding the same single fetch,
+        #: zero extra dispatches — and flush emits ``pop<i>_*`` rows
+        #: next to the aggregate stream when P > 1. ``n_episodes``
+        #: counts TOTAL episodes across members (P·K·B per push).
+        self.population = population
+        #: per-member return EMA surviving across flushes — the PBT
+        #: ranking signal (population.pbt_step member_perf); None until
+        #: a member has flushed at least once
+        self.member_return_ema: List = [None] * max(population, 0)
         self._pending = []          # un-fetched RolloutStats device refs
         self._eps_ref = None        # epsilon pushed since the last fetch
         self._eps_val = 0.0         # cached host value
         self._returns: List[float] = []   # folded per-episode returns
         self._stats = defaultdict(float)  # folded terminal-info sums
+        # member id -> {n, return_sum, <TERMINAL_INFO_KEYS sums>}
+        self._members = defaultdict(lambda: defaultdict(float))
         # graftworld per-scenario-slice aggregation (docs/ENVS.md):
         # family id -> {n, return_sum, <SLICE_KEYS sums>}; fed by the
         # SAME fold fetch as the overall sums — a stats object without a
@@ -107,6 +129,18 @@ class StatsAccumulator:
                 v = getattr(s, k, None)
                 if v is not None:
                     self._stats[k] += float(np.sum(v))
+            if self.population:
+                # per-member aggregation off the SAME fetched arrays:
+                # leaf layout (P, ...) — member i is row i
+                for m in range(self.population):
+                    mem = self._members[m]
+                    r_m = np.asarray(s.episode_return)[m].reshape(-1)
+                    mem["n"] += float(r_m.size)
+                    mem["return"] += float(r_m.sum())
+                    for k in TERMINAL_INFO_KEYS:
+                        v = getattr(s, k, None)
+                        if v is not None:
+                            mem[k] += float(np.sum(np.asarray(v)[m]))
             scenario = getattr(s, "scenario", None)
             if scenario is not None:
                 fam = np.asarray(scenario).reshape(-1).astype(np.int64)
@@ -121,9 +155,14 @@ class StatsAccumulator:
                             sl[k] += float(
                                 np.asarray(v).reshape(-1)[sel].sum())
         # the last pending entry owns the epsilon ref — same fetch; a
-        # stacked push's most recent value is its LAST row
-        self._eps_val = float(np.mean(
-            np.asarray(fetched[-1].epsilon).reshape(-1)[-1:]))
+        # stacked push's most recent value is its LAST row. Under a
+        # population the logged aggregate `epsilon` is MEMBER 0's (the
+        # un-scaled schedule — the solo run's value); pop<i> epsilons
+        # differ only by the static eps_scale grid, not worth P rows
+        eps = np.asarray(fetched[-1].epsilon)
+        if self.population:
+            eps = eps[0]
+        self._eps_val = float(np.mean(eps.reshape(-1)[-1:]))
         self._eps_ref = None
         self._pending.clear()
 
@@ -139,9 +178,12 @@ class StatsAccumulator:
         which is where cadenced callers should get it."""
         if self._eps_ref is not None:
             # a stacked (K,) superstep push reports its LAST sub-iteration
+            # (member 0's under a population — see _fold)
             self.fetches += 1
-            self._eps_val = float(np.asarray(
-                jax.device_get(self._eps_ref)).reshape(-1)[-1])
+            eps = np.asarray(jax.device_get(self._eps_ref))
+            if self.population:
+                eps = eps[0]
+            self._eps_val = float(eps.reshape(-1)[-1])
             self._eps_ref = None
         return self._eps_val
 
@@ -151,7 +193,11 @@ class StatsAccumulator:
         accumulated episodes span MORE than one scenario-family slice
         (a graftworld distribution), per-slice rows follow under
         ``<prefix>slice<fam>_*`` keys — single-scenario runs keep the
-        exact pre-graftworld metric stream."""
+        exact pre-graftworld metric stream. A graftpop population
+        (P > 1) additionally emits per-member ``<prefix>pop<i>_*`` rows
+        and refreshes :attr:`member_return_ema` (the PBT ranking
+        signal) — same fetch, zero extra dispatches; P <= 1 keeps the
+        exact single-experiment stream (the P=1 parity contract)."""
         self._fold()                              # ONE host round-trip
         if self._returns:
             logger.log_stat(prefix + "return_mean",
@@ -159,6 +205,23 @@ class StatsAccumulator:
         n = max(self.n_episodes, 1)
         for k, v in self._stats.items():
             logger.log_stat(prefix + k + "_mean", v / n, t_env)
+        if self.population:
+            for m in sorted(self._members):
+                mem = self._members[m]
+                if not mem.get("n"):
+                    continue
+                mn = max(mem["n"], 1.0)
+                r = mem["return"] / mn
+                ema = self.member_return_ema[m]
+                self.member_return_ema[m] = (
+                    r if ema is None else 0.7 * ema + 0.3 * r)
+                if self.population > 1:
+                    tag = f"{prefix}pop{m}_"
+                    logger.log_stat(tag + "return_mean", r, t_env)
+                    for k in POP_MEMBER_KEYS:
+                        if k in mem:
+                            logger.log_stat(tag + k + "_mean",
+                                            mem[k] / mn, t_env)
         if len(self._slices) > 1:
             for fam in sorted(self._slices):
                 sl = self._slices[fam]
@@ -171,5 +234,6 @@ class StatsAccumulator:
                     logger.log_stat(tag + k + "_mean", sl[k] / sn, t_env)
         self._returns.clear()
         self._stats.clear()
+        self._members.clear()
         self._slices.clear()
         self.n_episodes = 0

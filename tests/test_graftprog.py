@@ -525,7 +525,8 @@ def test_registry_names_and_structure():
                         "learner_train_pallas", "learner_train_pallas_ref",
                         "actor_step", "learner_step",
                         "env_reset", "env_step",
-                        "train_iter_sight", "superstep_sight"}
+                        "train_iter_sight", "superstep_sight",
+                        "superstep_pop"}
     # the donated hot programs are the compiled (memory-audited) ones
     assert reg["superstep"].compile and reg["train_iter"].compile
     assert reg["superstep"].donate_argnums == (0,)

@@ -710,9 +710,10 @@ def test_daemon_legs_matrix():
         artifact = None
         legs = None
     legs = dict(bench._daemon_legs(A()))
-    assert set(legs) == {"superstep", "kernels", "sebulba"}
+    assert set(legs) == {"superstep", "kernels", "sebulba", "population"}
     assert "--smoke" in legs["superstep"]
     assert legs["kernels"][:2] == ["--kernels", "ab"]
+    assert legs["population"][:2] == ["--population", "4"]
     A.artifact = "/art"
     assert "serve" in dict(bench._daemon_legs(A()))
     A.legs = "superstep,sebulba"
