@@ -1373,6 +1373,198 @@ def bench_serve(args) -> int:
     return 0
 
 
+def bench_serve_chaos(args) -> int:
+    """``--serve --chaos``: the fleet-under-fire measurement (ROADMAP
+    item 4 — "p99-under-burst as a ratcheted number instead of a
+    hope").
+
+    Drives a :class:`~t2omca_tpu.serve.fleet.ServeFleet` of
+    ``--fleet-engines`` share-nothing engines with **bursty
+    heavy-tailed open-loop traffic** (Pareto-tailed request sizes;
+    exponential arrivals whose rate steps up 5x inside burst windows;
+    open-loop = requests are submitted on the clock whether or not
+    earlier ones completed — the only honest way to measure shedding)
+    while a **fault schedule** runs underneath:
+
+    * engine 0 killed mid-burst (injected non-transient dispatch fault
+      → quarantine, bounce, backoff restart, rejoin);
+    * one injected dispatch hang on a peer engine (watchdog stall →
+      hedge + quarantine);
+    * one poisoned hot refresh (nonexistent checkpoint → must be
+      REFUSED while serving continues).
+
+    One BENCH-style JSON record: p50/p99 under burst (the ratchet
+    value is the p99), shed fraction, engine recovery time, hedge and
+    stall counters, the refresh outcome — and ``unresolved``, which a
+    correct fleet keeps at exactly 0 (every admitted request completes
+    or resolves with an explicit SHED/deadline/error status)."""
+    import jax
+
+    from t2omca_tpu.serve.fleet import FleetConfig, ServeFleet
+    from t2omca_tpu.utils import resilience
+
+    duration = float(args.chaos_seconds)
+    n_eng = int(args.fleet_engines)
+    fcfg = FleetConfig(
+        queue_depth=32,
+        deadline_s=max(2.0, duration / 2.5),
+        dispatch_timeout_s=max(0.75, min(2.0, duration / 6.0)),
+        restart_backoff_s=0.05, restart_backoff_max_s=0.5,
+        ladder_cooldown_s=0.25,
+    )
+    with _REC.span("bench.build", leg="serve-chaos"):
+        fleet = ServeFleet(args.artifact, n_engines=n_eng,
+                           dtype=args.serve_dtype, cfg=fcfg,
+                           rec=_REC).start()
+    try:
+        if fleet.serving_engines() == 0:
+            st = fleet.stats()
+            raise RuntimeError(
+                f"no fleet engine reached serving: {st['engines']}")
+        with _REC.span("bench.compile", leg="serve-chaos"):
+            fleet.warmup()
+
+        fe0 = fleet.engines[0].fe
+        a, d, na = fe0.n_agents, fe0.obs_dim, fe0.n_actions
+        bmax = fe0.buckets[-1]
+        rng = np.random.default_rng(0)
+
+        # request pool: heavy-tailed sizes (Pareto tail past the max
+        # bucket exercises the chunking path), one pre-built request
+        # per distinct size so the open-loop submitter costs ~nothing
+        sizes = np.minimum(1 + rng.pareto(1.1, 4096).astype(np.int64),
+                           2 * bmax)
+        pool = {}
+        for n in np.unique(sizes):
+            n = int(n)
+            obs = rng.standard_normal((n, a, d)).astype(np.float32)
+            avail = rng.random((n, a, na)) < 0.7
+            avail[..., 0] = True
+            pool[n] = (obs, avail)
+
+        # fault schedule (one-shot each, on the fleet's own chaos hooks)
+        kill_at = 0.25 * duration
+        refresh_at = 0.40 * duration
+        hang_at = 0.55 * duration
+        hang_s = fcfg.dispatch_timeout_s + min(1.5, 0.2 * duration)
+        hang_engine = 1 % n_eng
+        t0 = time.monotonic()
+        killed, hung = [], []
+
+        def _fault_schedule(engine, attempt, rid, **kw):
+            now = time.monotonic() - t0
+            if engine == 0 and not killed and now >= kill_at:
+                killed.append(now)
+                raise RuntimeError("chaos: engine killed (injected)")
+            if engine == hang_engine and not hung and now >= hang_at:
+                hung.append(now)
+                time.sleep(hang_s)
+
+        resilience.register_fault("fleet.dispatch", _fault_schedule)
+
+        refresh_out = {}
+
+        def _poisoned_refresh():
+            refresh_out.update(fleet.refresh(
+                os.path.join(args.artifact, "_no_such_checkpoint")))
+
+        poison = threading.Timer(refresh_at, _poisoned_refresh)
+        poison.daemon = True
+        poison.start()
+
+        # bursty open-loop arrivals: base rate sized to the measured
+        # warm dispatch so CPU and TPU runs both saturate in bursts
+        t_warm0 = time.perf_counter()
+        fleet.select(*pool[min(pool)])
+        warm_s = max(time.perf_counter() - t_warm0, 1e-4)
+        base_rate = max(10.0, min(200.0, 1.5 * n_eng / warm_s))
+        bursts = [(0.2 * duration, 0.3 * duration),
+                  (0.5 * duration, 0.65 * duration),
+                  (0.8 * duration, 0.9 * duration)]
+
+        def rate_at(t):
+            burst = any(lo <= t < hi for lo, hi in bursts)
+            return base_rate * (5.0 if burst else 1.0)
+
+        requests = []
+        with _REC.span("bench.chaos", leg="serve-chaos"):
+            t = 0.0
+            i = 0
+            while t < duration:
+                now = time.monotonic() - t0
+                if now < t:
+                    time.sleep(min(t - now, 0.05))
+                    continue
+                n = int(sizes[i % len(sizes)])
+                requests.append(fleet.submit(*pool[n]))
+                i += 1
+                t += rng.exponential(1.0 / rate_at(t))
+            # drain: every admitted request must resolve (completion,
+            # SHED, deadline or error) — the supervisor's deadline
+            # sweep bounds this wait
+            results = [r.wait(timeout=fcfg.deadline_s + 2.0)
+                       for r in requests]
+        poison.join(timeout=30.0)
+    finally:
+        resilience.clear_faults("fleet.dispatch")
+        stats = fleet.stats()
+        fleet.stop()
+
+    by = {}
+    for r in results:
+        by[r.status] = by.get(r.status, 0) + 1
+    ok_lat = sorted(r.latency_ms for r in results if r.ok)
+    unresolved = sum(1 for r in results
+                     if r.status == "error"
+                     and "unresolved" in (r.error or ""))
+    p50 = p99 = None
+    if ok_lat:
+        p50, p99 = np.percentile(ok_lat, [50, 99])
+    recov = stats["recoveries_s"]
+    shed_fraction = by.get("shed", 0) / max(len(results), 1)
+    print(f"# chaos traffic: {len(results)} requests over "
+          f"{duration:.1f}s ({base_rate:.0f}/s base, 5x bursts) — "
+          f"{by.get('ok', 0)} ok, {by.get('shed', 0)} shed, "
+          f"{by.get('deadline', 0)} deadline, {by.get('error', 0)} "
+          f"error, {unresolved} unresolved", file=sys.stderr)
+    print(f"# faults: kill@{killed[0] if killed else None}s "
+          f"hang@{hung[0] if hung else None}s "
+          f"refresh={refresh_out.get('status')} "
+          f"recoveries={recov} "
+          f"serving_end={stats['serving']}/{n_eng}", file=sys.stderr)
+    print(json.dumps(_finalize({
+        "metric": "serve_chaos_p99_ms",
+        "value": round(float(p99), 3) if p99 is not None else None,
+        "unit": "ms",
+        "vs_baseline": None,
+        "p50_ms": round(float(p50), 3) if p50 is not None else None,
+        "p99_ms": round(float(p99), 3) if p99 is not None else None,
+        "requests": len(results),
+        "ok": by.get("ok", 0),
+        "shed": by.get("shed", 0),
+        "deadline": by.get("deadline", 0),
+        "errors": by.get("error", 0),
+        "unresolved": unresolved,
+        "shed_fraction": round(shed_fraction, 4),
+        "recovery_s": (round(max(recov), 3) if recov else None),
+        "recoveries_s": recov,
+        "hedges": stats.get("fleet_hedges_total", 0),
+        "stalls": stats.get("fleet_stalls_total", 0),
+        "engine_restarts": stats.get("fleet_restarts_total", 0),
+        "ejected": stats.get("fleet_ejected_total", 0),
+        "ladder_level_end": stats.get("ladder_level", 0),
+        "refresh": refresh_out or None,
+        "engines": n_eng,
+        "engines_serving_end": stats["serving"],
+        "duration_s": duration,
+        "base_rate_rps": round(base_rate, 1),
+        "dtype": args.serve_dtype,
+        "backend": jax.default_backend(),
+        "artifact": args.artifact,
+    }), default=repr))
+    return 0
+
+
 def bench_all(make_cfg, _time, _pipe_rate, args) -> int:
     """``--all``: the full single-chip measurement set in ONE process —
     one backend init total, for tunnel-scarce conditions (BASELINE.md
@@ -1817,6 +2009,18 @@ def main() -> int:
     ap.add_argument("--serve-dtype", choices=("float32", "bfloat16"),
                     default="float32",
                     help="--serve: which param variant to serve")
+    ap.add_argument("--chaos", action="store_true",
+                    help="--serve: drive the multi-engine FLEET "
+                         "(serve/fleet.py) under bursty heavy-tailed "
+                         "open-loop traffic plus a fault schedule "
+                         "(engine kill mid-burst, injected dispatch "
+                         "hang, poisoned refresh) — reports p99 under "
+                         "burst, shed fraction and engine recovery "
+                         "time (docs/SERVING.md §fleet)")
+    ap.add_argument("--fleet-engines", type=int, default=2,
+                    help="--serve --chaos: engines in the fleet")
+    ap.add_argument("--chaos-seconds", type=float, default=8.0,
+                    help="--serve --chaos: open-loop traffic duration")
     ap.add_argument("--kernels", choices=("xla", "pallas", "ab"),
                     default=None,
                     help="attention-kernel A/B leg: measure the DENSE "
@@ -1908,8 +2112,15 @@ def main() -> int:
         if args.pipeline:
             ap.error("--serve has its own hidden-carried throughput "
                      "leg; drop --pipeline")
+        if args.fleet_engines < 1:
+            ap.error("--fleet-engines must be >= 1")
+        if args.chaos_seconds <= 0:
+            ap.error("--chaos-seconds must be > 0")
     elif args.artifact is not None:
         ap.error("--artifact only applies to --serve")
+    elif args.chaos:
+        ap.error("--chaos only applies to --serve (the fleet chaos "
+                 "traffic leg needs an exported artifact)")
     if args.kernels is not None:
         if (args.all or args.hbm or args.prod_hbm or args.breakdown
                 or args.train or args.serve or args.superstep is not None
@@ -2048,8 +2259,10 @@ def main() -> int:
     _RECORD_EXTRA.setdefault("platform", jax.default_backend())
 
     if args.serve:
-        # the serving leg needs no train config at all — everything
+        # the serving legs need no train config at all — everything
         # (model, buckets, params) comes from the artifact's meta
+        if args.chaos:
+            return bench_serve_chaos(args)
         return bench_serve(args)
 
     from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,
@@ -2342,7 +2555,10 @@ def main_flight() -> int:
         # match main()'s probe-failure record: a crashed --train or
         # --serve run must not file its partial record under the
         # rollout metric
-        metric, unit = (("serve_decisions_per_sec", "decisions/s/chip")
+        metric, unit = (("serve_chaos_p99_ms", "ms")
+                        if "--serve" in sys.argv and "--chaos" in sys.argv
+                        else ("serve_decisions_per_sec",
+                              "decisions/s/chip")
                         if "--serve" in sys.argv
                         else ("train_steps_per_sec", "train-steps/s/chip")
                         if "--train" in sys.argv

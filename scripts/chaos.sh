@@ -6,19 +6,26 @@
 # after every scenario that the run ended in a RESUMABLE state (a
 # verify_checkpoint-passing checkpoint a fresh driver carries to t_max).
 #
+# The serve scenario (tests/test_fleet.py, docs/SERVING.md §fleet) runs
+# in the same battery: an engine killed mid-burst plus an injected
+# dispatch hang must end with ZERO hung requests (every admitted
+# request completes or resolves SHED/deadline/error) and a RESUMABLE
+# fleet — the quarantined engines restarted, rejoined, and serving a
+# fresh request.
+#
 # Usage: bash scripts/chaos.sh [N]      (default N=3)
 #
-# Slow by design (each scenario is a full run() with fresh compiles, the
-# battery is ~6 runs + resume legs per cycle) — this is the soak gate for
-# resilience PRs, not part of the tier-1 budget (the same scenarios run
-# once under `-m 'chaos'`; tier-1 excludes them via `-m 'not slow'`).
+# Slow by design (each driver scenario is a full run() with fresh
+# compiles; the serve scenario exports an artifact and runs the chaos
+# traffic bench) — this is the soak gate for resilience PRs, not part
+# of the tier-1 budget (tier-1 excludes them via `-m 'not slow'`).
 set -o pipefail
 N=${1:-3}
 cd "$(dirname "$0")/.." || exit 2
 for i in $(seq 1 "$N"); do
   echo "== chaos cycle $i/$N =="
-  JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -m chaos -q \
-    -p no:cacheprovider -p no:randomly || {
+  JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_fleet.py \
+    -m chaos -q -p no:cacheprovider -p no:randomly || {
       echo "chaos cycle $i/$N FAILED — a fault scenario left the run "
       echo "unresumable (see the assertion above; docs/RESILIENCE.md §5)"
       exit 1

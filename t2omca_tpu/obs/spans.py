@@ -100,6 +100,15 @@ KNOWN_PHASES = frozenset({
     # serving run's spans.jsonl exactly like a training run's
     "serve.export", "serve.load", "serve.pad", "serve.dispatch",
     "serve.unpad",
+    # graftfleet multi-engine serving (serve/fleet.py): per-engine
+    # artifact load, the supervised per-request dispatch envelope (the
+    # watchdog-stamped boundary; serve.* spans nest inside it), the
+    # engine health-check dispatch, a quarantined engine's restart
+    # reload, and the rolling hot-param-refresh path (fold + roll
+    # stages). bench.chaos is the chaos traffic leg's measure window
+    # (bench.py --serve --chaos)
+    "fleet.load", "fleet.dispatch", "fleet.selfcheck", "fleet.restart",
+    "fleet.refresh", "bench.chaos",
     # graftpulse live telemetry plane (obs/pulse.py, obs/memwatch.py):
     # one /metrics-endpoint scrape, one per-device HBM snapshot, the
     # PULSE_TRACE-file / /trace-endpoint arming of a live trace window,

@@ -13,6 +13,11 @@ host-side batcher.
 * ``serve/frontend.py`` — the batched front-end: ragged request
   batches pad/bucket into the compiled shapes, with per-request hidden
   carry and full span telemetry.
+* ``serve/fleet.py`` — graftfleet: N share-nothing frontends behind a
+  bounded admission queue with per-engine supervision (watchdog +
+  quarantine + backoff restart), hedged retries, explicit load
+  shedding, a pressure-degradation ladder and rolling hot param
+  refresh with fingerprint gate and auto-rollback (ROADMAP item 4).
 
 Gated by the same static machinery as training: the serve step is
 ratcheted in ``analysis/programs.json`` (FLOPs/bytes/fingerprint), the
@@ -23,11 +28,14 @@ contract.
 
 from .export import (ARTIFACT_FORMAT, DEFAULT_BUCKETS, export_artifact,
                      load_acting_params)
+from .fleet import (FleetConfig, FleetResult, RefreshRefused, ServeFleet,
+                    check_refresh)
 from .frontend import ServeFrontend, SessionStore, pad_request, pick_bucket
 from .program import build_serve_step, serve_avals
 
 __all__ = [
-    "ARTIFACT_FORMAT", "DEFAULT_BUCKETS", "ServeFrontend", "SessionStore",
-    "build_serve_step", "export_artifact", "load_acting_params",
-    "pad_request", "pick_bucket", "serve_avals",
+    "ARTIFACT_FORMAT", "DEFAULT_BUCKETS", "FleetConfig", "FleetResult",
+    "RefreshRefused", "ServeFleet", "ServeFrontend", "SessionStore",
+    "build_serve_step", "check_refresh", "export_artifact",
+    "load_acting_params", "pad_request", "pick_bucket", "serve_avals",
 ]

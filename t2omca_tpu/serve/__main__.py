@@ -11,8 +11,17 @@ Subcommands::
     # inspect an artifact
     python -m t2omca_tpu.serve info /path/to/artifact
 
-Exit codes: 0 ok, 2 usage error (missing checkpoint / bad artifact).
-The config must be the TRAINING run's config (the exporter rebuilds the
+    # hot-refresh dry run: would a live fleet accept this checkpoint?
+    # (host-side re-fold + per-bucket program fingerprint check —
+    # exactly what ServeFleet.refresh runs before any engine is
+    # touched; a live fleet arms the real thing via its
+    # <artifact>/FLEET_REFRESH trigger file)
+    python -m t2omca_tpu.serve refresh /path/to/artifact <ckpt_dir> \
+        [--dtype float32]
+
+Exit codes: 0 ok (export written / refresh compatible), 2 usage error
+(missing checkpoint / bad artifact / refresh REFUSED). The export
+config must be the TRAINING run's config (the exporter rebuilds the
 exact MAC from it and shape-validates the checkpoint against it; a
 mismatch is a hard error, not a silent re-init).
 """
@@ -64,6 +73,17 @@ def main(argv=None) -> int:
     info = sub.add_parser("info", help="print an artifact's meta summary")
     info.add_argument("artifact_dir")
 
+    ref = sub.add_parser("refresh",
+                         help="hot-refresh dry run: fold a checkpoint "
+                              "and fingerprint-check it against an "
+                              "artifact's programs")
+    ref.add_argument("artifact_dir")
+    ref.add_argument("ckpt_dir",
+                     help="checkpoint directory holding the NEW params")
+    ref.add_argument("--dtype", choices=("float32", "bfloat16"),
+                     default="float32",
+                     help="the serving param variant to check")
+
     # key=value overrides ride as unrecognized trailing args (argparse
     # cannot mix a trailing nargs="*" positional with the option flags
     # above) — validate them here instead
@@ -101,6 +121,24 @@ def main(argv=None) -> int:
         prov = meta.get("provenance", {})
         print(f"provenance: git={str(prov.get('git_commit'))[:12]} "
               f"jax={prov.get('jax')} backend={prov.get('backend')}")
+        return 0
+
+    if args.command == "refresh":
+        if not os.path.isfile(os.path.join(args.artifact_dir,
+                                           "meta.json")):
+            print(f"serve: error: {args.artifact_dir} is not a serve "
+                  f"artifact (no meta.json)", file=sys.stderr)
+            return 2
+        from .fleet import check_refresh
+        out = check_refresh(args.artifact_dir, args.ckpt_dir,
+                            dtype=args.dtype)
+        if out["status"] != "compatible":
+            print(f"serve: refresh REFUSED: {out.get('reason')}",
+                  file=sys.stderr)
+            return 2
+        print(f"serve: refresh compatible (checkpoint "
+              f"t_env={out.get('t_env')}, {out.get('buckets_checked')} "
+              f"bucket programs fingerprint-checked)")
         return 0
 
     # ---- export ----

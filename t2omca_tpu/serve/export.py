@@ -53,7 +53,7 @@ from ..controllers.basic_mac import MAC_REGISTRY
 from ..envs.registry import make_env
 from ..obs.spans import NULL_RECORDER
 from ..utils.checkpoint import find_checkpoint, restore_host_state
-from ..utils.ioutil import write_json_atomic
+from ..utils.ioutil import write_bytes_atomic, write_json_atomic
 from .program import build_serve_step, serve_avals
 
 logger = logging.getLogger(__name__)
@@ -208,8 +208,11 @@ def export_artifact(cfg: TrainConfig, ckpt_dir: str, out_dir: str,
         blob = serialization.msgpack_serialize(
             jax.tree.map(lambda x: np.asarray(jax.device_get(x)), variant))
         fname = f"params_{dtype_name}.msgpack"
-        with open(os.path.join(out_dir, fname), "wb") as f:
-            f.write(blob)
+        # atomic (tmp+fsync+rename, like meta.json): a crash mid-export
+        # must never publish a truncated blob at the final path — the
+        # front-end's sha256 check would reject it, but only AFTER a
+        # serving process trusted the artifact enough to load it
+        write_bytes_atomic(os.path.join(out_dir, fname), blob)
         params_meta[dtype_name] = {"file": fname,
                                    "sha256": _sha256_bytes(blob),
                                    "bytes": len(blob)}
@@ -239,8 +242,8 @@ def export_artifact(cfg: TrainConfig, ckpt_dir: str, out_dir: str,
                                                        avail, hidden)
                     eblob = exported.serialize()
                     bname = f"serve_step_{dtype_name}_b{b}.jaxexport"
-                    with open(os.path.join(prog_dir, bname), "wb") as f:
-                        f.write(eblob)
+                    write_bytes_atomic(os.path.join(prog_dir, bname),
+                                       eblob)
                     # validate + warm-start with the program the
                     # FRONT-END actually dispatches — jit over the
                     # deserialized call has its own cache key, so

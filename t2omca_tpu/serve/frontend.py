@@ -297,33 +297,50 @@ class SessionStore:
     serves the batch, and scatters the new hiddens back. Call
     :meth:`end` when a session's episode finishes (or rely on
     ``max_sessions`` LRU eviction — an evicted session restarts from
-    zeros, degraded but well-defined)."""
+    zeros, degraded but well-defined, and NOT silent: each eviction
+    increments the ``serve_session_evicted`` stat, and :meth:`select`
+    returns a per-row ``fresh`` sentinel so a caller who believes a
+    session is live can detect the mid-conversation reset)."""
 
     def __init__(self, frontend: ServeFrontend,
                  max_sessions: int = 100_000) -> None:
         self._fe = frontend
         self._max = int(max_sessions)
         self._h: Dict[object, np.ndarray] = {}
+        self.evicted = 0                # cumulative LRU evictions
 
     def __len__(self) -> int:
         return len(self._h)
 
-    def select(self, session_ids: Sequence, obs, avail) -> np.ndarray:
+    def select(self, session_ids: Sequence, obs, avail
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """→ ``(actions (n, A) int32, fresh (n,) bool)``. ``fresh[i]``
+        is True when row i's session had NO carried hidden — a brand-new
+        session, or a live one whose carry was LRU-evicted (the caller
+        knows which ids it just created, so fresh on a supposedly-live
+        id IS the eviction sentinel)."""
         if len(session_ids) != np.asarray(obs).shape[0]:
             raise ValueError(
                 f"{len(session_ids)} session ids for "
                 f"{np.asarray(obs).shape[0]} request rows")
         fe = self._fe
         zeros = np.zeros((fe.n_agents, fe.emb), np.float32)
+        fresh = np.array([s not in self._h for s in session_ids], np.bool_)
         hidden = np.stack([self._h.get(s, zeros) for s in session_ids])
         actions, hidden2 = fe.select(obs, avail, hidden)
         for i, s in enumerate(session_ids):
             # move-to-end LRU semantics: re-insert on every touch
             self._h.pop(s, None)
             self._h[s] = hidden2[i]
+        hub = getattr(fe, "_hub", None)     # duck-typed frontends (tests)
         while len(self._h) > self._max:
             self._h.pop(next(iter(self._h)))
-        hub = getattr(fe, "_hub", None)     # duck-typed frontends (tests)
+            # an eviction drops a LIVE conversation's carry (the victim
+            # was touched more recently than never) — count it where
+            # the operator can see it instead of silently degrading
+            self.evicted += 1
+            if hub is not None:
+                hub.inc("serve_session_evicted")
         if hub is not None:
             # LRU fill fraction: 1.0 means evictions are live and
             # long-lived sessions silently restart from zero hiddens —
@@ -331,7 +348,7 @@ class SessionStore:
             hub.set("serve_sessions", len(self._h))
             hub.set("serve_session_lru_fill",
                     len(self._h) / self._max if self._max else 1.0)
-        return actions
+        return actions, fresh
 
     def end(self, session_id) -> None:
         self._h.pop(session_id, None)

@@ -70,6 +70,31 @@ def read_jsonl_tolerant(path: str,
     return out
 
 
+def write_bytes_atomic(path: str, blob: bytes) -> str:
+    """tmp + flush + fsync + rename for BINARY blobs — the twin of
+    :func:`write_json_atomic` for the serve artifact's msgpack param
+    variants and ``jax.export`` program blobs (serve/export.py): a
+    crash mid-export must never leave a half-written blob at the final
+    path for ``ServeFrontend.load`` to trust. Same unique-tmp rule as
+    the JSON writer (concurrent writers of one artifact must not
+    interleave), same cleanup-and-propagate error policy."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
 def write_json_atomic(path: str, payload: Any,
                       default: Callable[[Any], str] = repr) -> str:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

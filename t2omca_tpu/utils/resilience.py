@@ -108,6 +108,22 @@ logger = logging.getLogger(__name__)
 #:   ``params.sync``         t_env=<int>
 #:       at the learner→actor parameter publish (learner side, stamped)
 #:       and the actor's staleness-bounded adopt wait (span only).
+#:   ``fleet.dispatch``      engine=<int>, attempt=<int>, rid=<int>
+#:       inside EACH attempt of a fleet engine's per-request dispatch
+#:       (serve/fleet.py), under the engine's own watchdog stamp —
+#:       sleep to simulate a wedged engine (quarantine + hedge +
+#:       restart), raise transient to exercise the in-place retry,
+#:       raise non-transient to kill the engine outright.
+#:   ``fleet.selfcheck``     engine=<int>, stage=<str>
+#:       inside the engine health-check dispatch (start / restart /
+#:       degrade / refresh stages) — raise at stage="refresh" to trip
+#:       the post-swap health check and force the rolling refresh's
+#:       auto-rollback.
+#:   ``fleet.refresh``       stage=<str>, ...
+#:       at the hot-refresh fold (stage="fold", ckpt=) and per-bucket
+#:       fingerprint check (stage="fingerprint", bucket=, fingerprint=)
+#:       — raise at "fold" to poison a refresh (must be REFUSED while
+#:       the fleet keeps serving).
 _FAULTS: Dict[str, List[Callable]] = {}
 
 

@@ -68,11 +68,22 @@ def test_pad_request_mask_correct():
 def test_session_store_carries_and_evicts():
     from t2omca_tpu.serve.frontend import SessionStore
 
+    class _FakeHub:
+        def __init__(self):
+            self.counts = {}
+
+        def inc(self, name, delta=1.0, **labels):
+            self.counts[name] = self.counts.get(name, 0) + delta
+
+        def set(self, name, value, **labels):
+            pass
+
     class _FakeFrontend:
         n_agents, emb = 2, 4
 
         def __init__(self):
             self.seen_hidden = []
+            self._hub = _FakeHub()
 
         def select(self, obs, avail, hidden=None):
             self.seen_hidden.append(np.array(hidden))
@@ -84,21 +95,147 @@ def test_session_store_carries_and_evicts():
     store = SessionStore(fe, max_sessions=2)
     obs1 = np.zeros((2, 2, 3), np.float32)
     avail1 = np.ones((2, 2, 5), np.bool_)
-    store.select(["a", "b"], obs1, avail1)
+    _, fresh = store.select(["a", "b"], obs1, avail1)
     assert not fe.seen_hidden[0].any()           # fresh sessions: zeros
-    store.select(["a", "b"], obs1, avail1)
+    assert fresh.dtype == np.bool_ and fresh.all()
+    _, fresh = store.select(["a", "b"], obs1, avail1)
     assert (fe.seen_hidden[1] == 1.0).all()      # carried hidden
+    assert not fresh.any()                       # both carries live
     # LRU eviction at max_sessions=2: "a"/"b" touched, "c" pushes out
     # the least recently used ("a" after "b" re-touch below)
     store.select(["b"], obs1[:1], avail1[:1])
     store.select(["c"], obs1[:1], avail1[:1])
     assert len(store) == 2
-    store.select(["a"], obs1[:1], avail1[:1])    # "a" evicted → fresh
+    assert store.evicted == 1                    # "a" silently dropped...
+    assert fe._hub.counts["serve_session_evicted"] == 1   # ...NOT silently
+    # the eviction sentinel: "a" believes it is live, fresh=True says
+    # its carry is gone and it restarted from zeros mid-conversation
+    _, fresh = store.select(["a"], obs1[:1], avail1[:1])
     assert not fe.seen_hidden[-1].any()
-    store.end("b")
-    assert len(store) == 2                       # c + re-added a
+    assert fresh.all()
+    assert store.evicted == 2                    # re-adding "a" evicted "b"
+    assert fe._hub.counts["serve_session_evicted"] == 2
+    store.end("c")
+    assert len(store) == 1                       # just the re-added "a"
     with pytest.raises(ValueError, match="session ids"):
         store.select(["a"], obs1, avail1)
+
+
+def _stub_frontend(buckets=(1, 2, 4), a=3, d=5, na=4, emb=8):
+    """A ServeFrontend over fake compiled steps: real host logic
+    (validate → chunk → pad → dispatch → unpad), zero jit."""
+    from t2omca_tpu.obs.spans import NULL_RECORDER
+    from t2omca_tpu.serve.frontend import ServeFrontend
+    meta = {"buckets": list(buckets), "n_agents": a, "obs_dim": d,
+            "n_actions": na, "emb": emb}
+    fe = ServeFrontend("/nonexistent", meta, mac=None, params=None,
+                       dtype="float32", use_exported=False,
+                       rec=NULL_RECORDER)
+    dispatched = []
+
+    def fake_step(params, obs, avail, hidden):
+        n = obs.shape[0]
+        dispatched.append(n)
+        # actions: lowest legal action; hidden: +1 so carry/stitching
+        # mistakes are observable per row
+        acts = np.argmax(avail, axis=-1).astype(np.int32)
+        return acts, hidden + 1.0
+
+    fe._steps = {b: fake_step for b in buckets}
+    return fe, dispatched
+
+
+def test_frontend_validate_rejects_malformed_requests():
+    fe, dispatched = _stub_frontend()
+    good_obs = np.zeros((2, 3, 5), np.float32)
+    good_avail = np.ones((2, 3, 4), np.bool_)
+    with pytest.raises(ValueError, match="obs must be"):
+        fe.select(np.zeros((2, 3), np.float32), good_avail)   # ndim
+    with pytest.raises(ValueError, match="obs must be"):
+        fe.select(np.zeros((2, 3, 6), np.float32), good_avail)  # obs_dim
+    with pytest.raises(ValueError, match="avail must be"):
+        fe.select(good_obs, np.ones((2, 3, 5), np.bool_))     # n_actions
+    with pytest.raises(ValueError, match="avail must be"):
+        fe.select(good_obs, np.ones((3, 3, 4), np.bool_))     # row count
+    with pytest.raises(ValueError, match="hidden must be"):
+        fe.select(good_obs, good_avail,
+                  np.zeros((2, 3, 7), np.float32))            # emb
+    with pytest.raises(ValueError, match="hidden must be"):
+        fe.select(good_obs, good_avail,
+                  np.zeros((1, 3, 8), np.float32))            # row count
+    # a rejected request dispatched NOTHING (validation precedes pad)
+    assert dispatched == []
+
+
+def test_frontend_chunks_ragged_bursts_past_max_bucket():
+    """Ragged burst schedule straddling the max bucket: every dispatch
+    lands on a compiled bucket shape (never above bmax), and the
+    stitched outputs keep per-row order and carried hidden across the
+    chunk seams."""
+    from t2omca_tpu.serve.frontend import pick_bucket
+    fe, dispatched = _stub_frontend(buckets=(1, 2, 4))
+    rng = np.random.default_rng(9)
+    for n in (7, 4, 9, 1, 5, 13, 3):         # ragged, mostly > bmax=4
+        obs = rng.standard_normal((n, 3, 5)).astype(np.float32)
+        avail = rng.random((n, 3, 4)) < 0.5
+        avail[..., 0] = True
+        del dispatched[:]
+        # per-row-distinct hidden: a chunk-seam row swap would show
+        hidden_in = rng.standard_normal((n, 3, 8)).astype(np.float32)
+        actions, hidden = fe.select(obs, avail, hidden_in)
+        # every dispatch is a compiled bucket, none above the max
+        assert all(b in (1, 2, 4) for b in dispatched), dispatched
+        # chunk cover: full chunks of bmax + one bucketed remainder
+        want = [4] * (n // 4)
+        if n % 4:
+            want.append(pick_bucket(n % 4, [1, 2, 4]))
+        assert dispatched == want, (n, dispatched)
+        # stitched per-row: action = first legal action of that row
+        np.testing.assert_array_equal(
+            actions, np.argmax(avail, axis=-1).astype(np.int32),
+            err_msg=f"n={n}")
+        np.testing.assert_array_equal(hidden, hidden_in + 1.0,
+                                      err_msg=f"n={n}")
+
+
+# ---------------------------------------------------------------------------
+# atomic artifact writes (satellite: torn-write safety for binary blobs)
+# ---------------------------------------------------------------------------
+
+
+def test_write_bytes_atomic_survives_torn_write(tmp_path, monkeypatch):
+    from t2omca_tpu.utils.ioutil import write_bytes_atomic
+    target = tmp_path / "params.msgpack"
+    write_bytes_atomic(str(target), b"v1-good")
+    assert target.read_bytes() == b"v1-good"
+    # a crash between tmp write and publish must leave the OLD blob
+    # intact and no tmp litter for the next export to trip on
+    real_replace = os.replace
+
+    def torn(src, dst):
+        raise OSError("injected: crash before publish")
+
+    monkeypatch.setattr(os, "replace", torn)
+    with pytest.raises(OSError, match="crash before publish"):
+        write_bytes_atomic(str(target), b"v2-half-written")
+    monkeypatch.setattr(os, "replace", real_replace)
+    assert target.read_bytes() == b"v1-good"     # old blob untouched
+    assert os.listdir(tmp_path) == ["params.msgpack"]   # no tmp leftovers
+    # and a clean retry publishes
+    write_bytes_atomic(str(target), b"v2-good")
+    assert target.read_bytes() == b"v2-good"
+
+
+def test_export_writes_no_raw_binary_handles():
+    """Source pin for the atomic-write satellite: serve/export.py must
+    route EVERY write through the atomic helpers (tmp + fsync + rename)
+    — a raw ``open(..., "wb")`` write would reintroduce the torn-blob
+    window the sha256 check can only detect, not prevent."""
+    src_path = os.path.join(REPO, "t2omca_tpu", "serve", "export.py")
+    with open(src_path) as f:
+        src = f.read()
+    assert '"wb"' not in src and "'wb'" not in src
+    assert "write_bytes_atomic" in src and "write_json_atomic" in src
 
 
 def test_train_config_dict_roundtrip():
