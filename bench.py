@@ -948,7 +948,7 @@ def bench_superstep(cfg, _time, args) -> int:
     return 0
 
 
-def bench_population(cfg, _time, args) -> int:
+def bench_population(cfg, _time, args, dp=None) -> int:
     """``--population P``: the graftpop experiment-throughput leg
     (docs/POPULATION.md). ONE vmapped population superstep advances P
     seed variants per dispatch (``run.Experiment.
@@ -957,7 +957,18 @@ def bench_population(cfg, _time, args) -> int:
     — which is exactly how the 16-AGV campaigns in git history burned
     wall-clock. Headline: ``experiments_per_sec`` = experiment·train-
     iters/s (P × per-dispatch iters / dispatch seconds); the record
-    carries both rates and ``population_speedup``."""
+    carries both rates and ``population_speedup``.
+
+    Graftlattice compositions (docs/PERF.md §lattice):
+
+    * ``--kernels pallas|xla`` selects the attention-kernel mode for
+      BOTH sides of the A/B (vmap-over-pallas: the member axis vmaps
+      over the fused flash kernels; dense acting forced like the
+      ``--kernels`` leg);
+    * ``dp=N`` (the ``--lattice`` matrix's population-over-dp sub-leg)
+      shards the LEADING member axis over an N-device mesh
+      (``parallel.population_shardings``) while the serialized baseline
+      stays single-device."""
     import dataclasses
 
     import jax
@@ -968,6 +979,17 @@ def bench_population(cfg, _time, args) -> int:
     from t2omca_tpu.run import Experiment
 
     p = args.population
+    mode = getattr(args, "kernels", None)
+    if mode is not None:
+        from t2omca_tpu.config import KernelsConfig
+        # dense acting: the kernel switch selects the program the dense
+        # rollout/learner unroll dispatches (bench_kernels docstring);
+        # the population axis vmaps OVER the flash kernels
+        cfg = cfg.replace(
+            model=dataclasses.replace(cfg.model, use_qslice=False),
+            kernels=KernelsConfig(attention=mode))
+    leg = ("population" if mode is None and not dp
+           else f"population-{mode or f'dp{dp}'}")
     k = 1                      # iters per dispatch: the speedup under
     # measurement is the population axis, not the superstep scan
     bs = 4 if args.smoke else 32
@@ -989,7 +1011,7 @@ def bench_population(cfg, _time, args) -> int:
             buffer_size=max(cfg.replay.buffer_size, 2 * b, bs)))
     pop_cfg = base.replace(population=PopulationConfig(size=p))
 
-    with _REC.span("bench.build", leg="population"):
+    with _REC.span("bench.build", leg=leg):
         exp = Experiment.build(pop_cfg)
         ts, spec = graftpop.init_population(exp, pop_cfg)
         # un-donated: the timed dispatches re-run on the same warm state
@@ -1000,6 +1022,17 @@ def bench_population(cfg, _time, args) -> int:
     keys = jnp.stack([jax.random.split(jax.random.PRNGKey(7 + m), k)
                       for m in range(p)])
     t_env = jnp.zeros((), jnp.int32)
+    if dp:
+        # population-over-dp: the mesh shards the LEADING member axis —
+        # whole members per device, no cross-member collectives; the
+        # key stack shards with the state so the dispatched program
+        # sees the same input shardings as the ratcheted
+        # pop_dp_superstep audit twin
+        from t2omca_tpu.parallel import make_mesh, population_shardings
+        mesh = make_mesh(dp)
+        ts = jax.device_put(ts, population_shardings(mesh, ts))
+        spec = jax.device_put(spec, population_shardings(mesh, spec))
+        keys = jax.device_put(keys, population_shardings(mesh, keys))
     # enough warm dispatches to FILL the ring past the train batch (each
     # inserts k·b episodes), so the timed dispatches exercise the train
     # branch of the gate in both modes — a fixed warm count would leave
@@ -1007,7 +1040,7 @@ def bench_population(cfg, _time, args) -> int:
     # (the vmapped select still executes-and-discards the train branch;
     # the solo scalar cond genuinely skips it)
     warm = max(2, -(-bs // (k * b)) + 1)
-    with _REC.span("bench.compile", leg="population", p=p, warm=warm):
+    with _REC.span("bench.compile", leg=leg, p=p, warm=warm):
         for _ in range(warm):
             ts, _, _ = prog(ts, keys, t_env, spec)
             solo_ts, _, _ = solo_prog(solo_ts, keys[0], t_env)
@@ -1019,7 +1052,7 @@ def bench_population(cfg, _time, args) -> int:
               "measures rollout+insert only", file=sys.stderr)
 
     t1k = jnp.asarray(1000, jnp.int32)
-    with _REC.span("bench.measure", leg="population", mode="vmapped"):
+    with _REC.span("bench.measure", leg=leg, mode="vmapped"):
         dt_pop = _time(
             lambda: prog(ts, keys, t1k, spec)[1].epsilon[-1, -1])
 
@@ -1035,20 +1068,23 @@ def bench_population(cfg, _time, args) -> int:
             out = solo_prog(solo_ts, keys[m], t1k)[1].epsilon[-1]
             _sync(out)
         return out
-    with _REC.span("bench.measure", leg="population", mode="serialized"):
+    with _REC.span("bench.measure", leg=leg, mode="serialized"):
         dt_serial = _time(_serial)
 
     pop_rate = p * k / dt_pop
     serial_rate = p * k / dt_serial
     speedup = dt_serial / dt_pop
-    print(f"# population P={p}: {dt_pop * 1e3:.1f} ms/dispatch vmapped "
-          f"vs {dt_serial * 1e3:.1f} ms for {p} serialized solo "
+    combo = ("" if mode is None and not dp else
+             f" × {f'kernels={mode}' if mode else f'dp={dp}'}")
+    print(f"# population P={p}{combo}: {dt_pop * 1e3:.1f} ms/dispatch "
+          f"vmapped vs {dt_serial * 1e3:.1f} ms for {p} serialized solo "
           f"dispatches ({speedup:.2f}x; {b} envs, train batch {bs}, "
           f"gate {'open' if gate_open else 'CLOSED'})", file=sys.stderr)
-    print(json.dumps(_finalize({
+    rec = {
         "metric": "experiments_per_sec",
         "value": round(pop_rate, 2),
-        "unit": "experiment-train-iters/s/chip",
+        "unit": (f"experiment-train-iters/s/{dp}-device-mesh" if dp
+                 else "experiment-train-iters/s/chip"),
         "vs_baseline": None,
         "population": p,
         "serialized_experiments_per_sec": round(serial_rate, 2),
@@ -1061,8 +1097,324 @@ def bench_population(cfg, _time, args) -> int:
         "train_gate_open": gate_open,
         "dispatch_s": round(dt_pop, 4),
         "serialized_dispatch_s": round(dt_serial, 4),
-    })))
+    }
+    # graftlattice composition identity (absent on the plain leg so its
+    # record shape is unchanged)
+    if mode is not None:
+        rec["kernels"] = mode
+    if dp:
+        rec["dp"] = dp
+    print(json.dumps(_finalize(rec)), flush=True)
     return 0
+
+
+def bench_population_sebulba(cfg, _time, args) -> int:
+    """``--population P --sebulba``: graftlattice's population × Sebulba
+    lockstep leg (docs/POPULATION.md §composition). The vmapped
+    population learner runs BEHIND the device-resident trajectory queue
+    on a 1+1 device split in lockstep (``queue_slots=1, staleness=0`` —
+    the only legal pop × sebulba regime, config.sanity_check), measured
+    four ways in ONE record:
+
+    * **population-classic** (context) — the fused vmapped population
+      superstep on a single device, async-chained with one terminal
+      sync: the shape ``--population`` alone measures. The fused
+      program strictly serializes rollout → train inside each dispatch,
+      so ``lockstep_vs_classic`` >= 1 exactly when the split's compute
+      overlap beats its queue/copy/publish cost — which requires >= 2
+      host cores (two CPU devices on a 1-core host time-slice one
+      core; the record's ``host_cores`` field says which regime was
+      measured);
+    * **serial-solo** (context) — the same P experiments as P separate
+      classic solo campaigns run serially, each dispatch fetched before
+      the next: the pre-graftlattice baseline the compounded
+      population x overlap win divides against
+      (``lockstep_vs_serial_solo``);
+    * **serialized** — the split pipeline run strictly phase-by-phase,
+      every stage blocked: the A/B floor that isolates what overlap
+      buys (``overlap_speedup``);
+    * **lockstep** (headline) — the production coordination
+      (``run.run_sebulba``): the actor thread's rollout ``i+1``
+      dispatches as soon as train ``i`` is ENQUEUED, so rollout
+      executes on the actor device while train executes on the learner
+      device — lockstep ordering (bit-parity with classic) with the
+      two stages' COMPUTE overlapped across the split.
+
+    env-steps are counted identically for all four legs (``k·B·T·P``).
+    Needs ≥ 2 devices (``--smoke`` forces 2 CPU host devices via the
+    ``--sebulba`` pre-import path)."""
+    import dataclasses
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from t2omca_tpu import population as graftpop
+    from t2omca_tpu.config import PopulationConfig, SebulbaConfig
+    from t2omca_tpu.run import Experiment
+
+    p = args.population
+    k = max(2 * args.iters, 6)
+    bs = 4 if args.smoke else 32
+    b, t_len = cfg.batch_size_run, cfg.env_args.episode_limit
+    env_steps = k * b * t_len * p
+    base = cfg.replace(
+        batch_size=bs,
+        population=PopulationConfig(size=p),
+        replay=dataclasses.replace(
+            cfg.replay, prioritized=True,
+            buffer_size=max(cfg.replay.buffer_size, 2 * b, bs)))
+
+    def _keys(i):
+        # per-member (P, 2) key column — the stacked shape the
+        # population learner step takes
+        return jnp.stack([jax.random.fold_in(jax.random.PRNGKey(7 + m), i)
+                          for m in range(p)])
+
+    # ---- population-classic context: one device, fused vmapped pop
+    # superstep, async-chained ----------------------------------------
+    with _REC.span("bench.build", leg="pop-sebulba-classic"):
+        exp = Experiment.build(base)
+        ts, spec = graftpop.init_population(exp, base)
+        # un-donated: rebinding keeps the warm state reusable
+        prog = exp.population_superstep_program(1)
+    # fill the ring past the train batch so every timed iteration takes
+    # the train branch in ALL legs (same warm discipline as
+    # bench_population)
+    warm = max(2, -(-bs // b) + 1)
+    with _REC.span("bench.compile", leg="pop-sebulba-classic", warm=warm):
+        for i in range(warm):
+            ts, stats, _ = prog(ts, _keys(900 + i)[:, None, :],
+                                jnp.asarray(0, jnp.int32), spec)
+        _sync(stats.epsilon[-1, -1])
+    ckeys = [_keys(1000 + i)[:, None, :] for i in range(k)]
+    t1k = jnp.asarray(1000, jnp.int32)
+    with _REC.span("bench.measure", leg="pop-sebulba-classic"):
+        t0 = time.perf_counter()
+        for i in range(k):
+            ts, stats, _ = prog(ts, ckeys[i], t1k, spec)
+        _sync(stats.epsilon[-1, -1])
+        dt_classic = time.perf_counter() - t0
+    rate_classic = env_steps / dt_classic
+    print(f"# pop x sebulba classic (1 device, fused vmapped superstep, "
+          f"P={p}): {dt_classic * 1e3:.1f} ms for {env_steps} env-steps "
+          f"+ {k} train iters/member -> {rate_classic:,.0f} env-steps/s",
+          file=sys.stderr)
+    del ts, spec, prog, exp
+
+    # ---- serial-solo context: the pre-graftlattice campaign reality —
+    # the SAME P experiments as P separate classic solo runs, one after
+    # the other (bench_population's serialized A/B; the denominator the
+    # ISSUE's compounded-smoke story multiplies against)
+    solo_cfg = cfg.replace(
+        batch_size=bs,
+        replay=dataclasses.replace(
+            cfg.replay, prioritized=True,
+            buffer_size=max(cfg.replay.buffer_size, 2 * b, bs)))
+    with _REC.span("bench.build", leg="pop-sebulba-solo"):
+        solo_exp = Experiment.build(solo_cfg)
+        solo_ts = solo_exp.init_train_state(0)
+        solo_prog = solo_exp.superstep_program(1)
+    with _REC.span("bench.compile", leg="pop-sebulba-solo", warm=warm):
+        for i in range(warm):
+            solo_ts, sstats, _ = solo_prog(
+                solo_ts, jax.random.split(jax.random.PRNGKey(900 + i), 1),
+                jnp.asarray(0, jnp.int32))
+        _sync(sstats.epsilon[-1])
+    solo_keys = [jax.random.split(
+        jax.random.fold_in(jax.random.PRNGKey(7 + m), 4000 + i), 1)
+        for i in range(k) for m in range(p)]
+    with _REC.span("bench.measure", leg="pop-sebulba-solo"):
+        t0 = time.perf_counter()
+        for sk in solo_keys:
+            # separate campaigns never overlap: each solo dispatch is
+            # fetched before the next begins (state reuse is fine —
+            # this times dispatches, not learning)
+            _sync(solo_prog(solo_ts, sk, t1k)[1].epsilon[-1])
+        dt_solo = time.perf_counter() - t0
+    rate_solo = env_steps / dt_solo
+    print(f"# pop x sebulba serial-solo context ({p} separate classic "
+          f"campaigns, 1 device): {dt_solo * 1e3:.1f} ms -> "
+          f"{rate_solo:,.0f} env-steps/s", file=sys.stderr)
+    del solo_ts, solo_prog, solo_exp
+
+    # ---- the lockstep split: 1 actor + 1 learner device ---------------
+    from t2omca_tpu.parallel.sebulba import make_sebulba
+    seb_cfg = base.replace(sebulba=SebulbaConfig(
+        actor_devices=1, learner_devices=1, queue_slots=1, staleness=0))
+    with _REC.span("bench.build", leg="pop-sebulba-split"):
+        exp2 = Experiment.build(seb_cfg)
+        seb = make_sebulba(exp2)
+        rs, ls = seb.init_states(0)
+        q = seb.init_queue()
+    actor_step, queue_put, queue_get, learner_step = seb.programs()
+    sb = seb_cfg.sebulba
+    slot0 = jnp.asarray(0, jnp.int32)
+
+    with _REC.span("bench.compile", leg="pop-sebulba-split", warm=warm):
+        # warm every program once AND fill the ring (put/get round-trips
+        # insert k·B episodes per member each)
+        params = seb.publish_params(ls.learner.params["agent"])
+        for i in range(warm):
+            rs, tm, _ = actor_step(params, rs, test_mode=False)
+            q = queue_put(q, slot0, seb.to_learner(tm))
+            ls, q = queue_get(ls, q, slot0)
+        ls, info = learner_step(ls, _keys(999), jnp.asarray(1000))
+        _sync(info["loss"][-1])
+
+    skeys = [_keys(3000 + i) for i in range(k)]
+    with _REC.span("bench.measure", leg="pop-sebulba-serial"):
+        t0 = time.perf_counter()
+        params = seb.publish_params(ls.learner.params["agent"])
+        jax.block_until_ready(params)
+        for i in range(k):
+            rs, tm, stats = actor_step(params, rs, test_mode=False)
+            jax.block_until_ready(stats.epsilon)
+            tm_l = seb.to_learner(tm)
+            jax.block_until_ready(tm_l.reward)
+            q = queue_put(q, slot0, tm_l)
+            ls, q = queue_get(ls, q, slot0)
+            ls, info = learner_step(ls, skeys[i], jnp.asarray(3000 + i))
+            _sync(info["loss"][-1])
+            params = seb.publish_params(ls.learner.params["agent"])
+            jax.block_until_ready(params)
+        dt_serial = time.perf_counter() - t0
+    rate_serial = env_steps / dt_serial
+    print(f"# pop x sebulba serialized split (1+1 devices, stage-"
+          f"synchronized): {dt_serial * 1e3:.1f} ms -> "
+          f"{rate_serial:,.0f} env-steps/s", file=sys.stderr)
+
+    okeys = [_keys(2000 + i) for i in range(k)]
+    cond = threading.Condition()
+    shared = {"q": q, "params": seb.publish_params(
+        ls.learner.params["agent"]), "put": 0, "consumed": 0,
+        "error": None}
+
+    def actor(rs=rs):
+        try:
+            for i in range(k):
+                with cond:
+                    # lockstep: rollout i+1 may dispatch as soon as
+                    # train i is ENQUEUED (consumed advanced) — its
+                    # device execution overlaps train i's
+                    while (i - shared["consumed"] > sb.staleness
+                           or shared["put"] - shared["consumed"]
+                           >= sb.queue_slots):
+                        cond.wait()
+                    params = shared["params"]
+                rs, tm, stats = actor_step(params, rs, test_mode=False)
+                jax.block_until_ready(stats.epsilon)
+                tm_l = seb.to_learner(tm)
+                with cond:
+                    shared["q"] = queue_put(
+                        shared["q"],
+                        jnp.asarray(shared["put"] % sb.queue_slots,
+                                    jnp.int32), tm_l)
+                    shared["put"] += 1
+                    cond.notify_all()
+        except Exception as e:  # noqa: BLE001 — surfaced by the main leg
+            with cond:
+                shared["error"] = e
+                cond.notify_all()
+
+    with _REC.span("bench.measure", leg="pop-sebulba-lockstep"):
+        t0 = time.perf_counter()
+        th = threading.Thread(target=actor, daemon=True)
+        th.start()
+        for i in range(k):
+            with cond:
+                while shared["put"] <= i and shared["error"] is None:
+                    cond.wait()
+                if shared["error"] is not None:
+                    raise shared["error"]
+                ls, shared["q"] = queue_get(
+                    ls, shared["q"],
+                    jnp.asarray(i % sb.queue_slots, jnp.int32))
+            ls, info = learner_step(ls, okeys[i], jnp.asarray(2000 + i))
+            with cond:
+                shared["params"] = seb.publish_params(
+                    ls.learner.params["agent"])
+                shared["consumed"] = i + 1
+                cond.notify_all()
+        _sync(info["loss"][-1])
+        dt_lock = time.perf_counter() - t0
+        th.join(timeout=30)
+    rate_lock = env_steps / dt_lock
+    overlap_speedup = rate_lock / rate_serial
+    vs_classic = rate_lock / rate_classic
+    vs_solo = rate_lock / rate_solo
+    print(f"# pop x sebulba lockstep (1+1 devices, queue_slots=1, "
+          f"staleness=0, P={p}): {dt_lock * 1e3:.1f} ms -> "
+          f"{rate_lock:,.0f} env-steps/s ({overlap_speedup:.2f}x "
+          f"serialized, {vs_classic:.2f}x fused population-classic, "
+          f"{vs_solo:.2f}x serial solo campaigns)", file=sys.stderr)
+    print(json.dumps(_finalize({
+        "metric": "env_steps_per_sec",
+        "value": round(rate_lock, 1),
+        "unit": "env-steps/s/2-device-split",
+        # per-chip semantics like the sebulba record: 2 chips in play
+        "vs_baseline": round(rate_lock / 2 / 50_000.0, 3),
+        "population": p,
+        "sebulba": {"actor_devices": 1, "learner_devices": 1,
+                    "queue_slots": sb.queue_slots,
+                    "staleness": sb.staleness},
+        "serialized_env_steps_per_sec": round(rate_serial, 1),
+        "overlap_speedup": round(overlap_speedup, 3),
+        # two classic contexts. `lockstep_vs_classic` divides by the
+        # fused single-device vmapped population superstep — the shape
+        # `--population` alone drives; >= 1 needs the rollout/train
+        # compute overlap to beat the split's queue+publish cost, which
+        # takes >= 2 host cores (on a 1-core host the two device
+        # streams time-slice one core and the copies are pure loss —
+        # host_cores says which regime this record measured).
+        # `lockstep_vs_serial_solo` divides by the pre-lattice
+        # baseline: the same P experiments as P separate classic solo
+        # campaigns run serially — the compounded population x overlap
+        # win the lattice exists to deliver.
+        "population_classic_env_steps_per_sec": round(rate_classic, 1),
+        "lockstep_vs_classic": round(vs_classic, 3),
+        "serial_solo_env_steps_per_sec": round(rate_solo, 1),
+        "lockstep_vs_serial_solo": round(vs_solo, 3),
+        "host_cores": os.cpu_count(),
+        "config": (None if args.smoke or args.envs or args.steps
+                   else args.config),
+        "n_envs": b,
+        "episode_steps": t_len,
+        "train_batch_episodes": bs,
+        "chained_iters": k,
+        "backend": jax.default_backend(),
+    })), flush=True)
+    return 0
+
+
+def bench_lattice(cfg, _time, args) -> int:
+    """``--lattice``: the graftlattice composition matrix
+    (docs/POPULATION.md §composition) — one schema-1 record per
+    newly-legal combo of the population axis with the other graft axes,
+    all in one process:
+
+    * population × pallas — the member axis vmapped over the fused
+      flash-attention kernels (vmapped vs serialized A/B);
+    * population × dp — whole members sharded over a 2-device mesh
+      (``parallel.population_shardings``);
+    * population × sebulba — the vmapped learner in lockstep behind the
+      device-resident queue, vs the fused classic pop superstep.
+
+    ``--population P`` selects the member count (default 4; must be
+    even for the 2-device dp sub-leg). Needs ≥ 2 devices (``--smoke``
+    forces 2 CPU host devices pre-import)."""
+    import argparse as _ap
+
+    def sub(**over):
+        ns = _ap.Namespace(**vars(args))
+        for key, val in over.items():
+            setattr(ns, key, val)
+        return ns
+
+    rc = bench_population(cfg, _time, sub(kernels="pallas"))
+    rc |= bench_population(cfg, _time, sub(kernels=None), dp=2)
+    rc |= bench_population_sebulba(cfg, _time, sub(kernels=None))
+    return rc
 
 
 def bench_train(cfg, _time, args) -> int:
@@ -1698,6 +2050,7 @@ def _daemon_legs(args) -> list:
         ("kernels", ["--kernels", "ab", *sm, *it]),
         ("sebulba", ["--sebulba", *sm, *it]),
         ("population", ["--population", "4", *sm, *it]),
+        ("lattice", ["--lattice", *sm, *it]),
     ]
     if args.artifact:
         legs.append(("serve",
@@ -1710,7 +2063,7 @@ def _daemon_legs(args) -> list:
         if unknown:
             raise SystemExit(
                 f"--legs: unknown leg(s) {sorted(unknown)}; valid: "
-                f"superstep,kernels,sebulba,population"
+                f"superstep,kernels,sebulba,population,lattice"
                 + (",serve" if args.artifact else
                    " (serve needs --artifact)"))
         legs = [(n, a) for n, a in legs if n in want]
@@ -2052,7 +2405,18 @@ def main() -> int:
                          "seed variants per dispatch vs the SAME P "
                          "experiments serialized as P solo dispatches "
                          "(docs/POPULATION.md). Reports experiments_"
-                         "per_sec + population_speedup")
+                         "per_sec + population_speedup. Composes with "
+                         "--kernels pallas|xla (vmap-over-pallas) and "
+                         "--sebulba (lockstep split, needs >= 2 "
+                         "devices) — the graftlattice legs")
+    ap.add_argument("--lattice", action="store_true",
+                    help="graftlattice composition matrix (docs/"
+                         "POPULATION.md §composition): the population "
+                         "axis composed with each other graft axis — "
+                         "kernels pallas, a dp=2 mesh, the sebulba "
+                         "lockstep split — one record per combo "
+                         "(--population picks P, default 4; needs >= 2 "
+                         "devices, --smoke forces 2 CPU host devices)")
     ap.add_argument("--daemon", action="store_true",
                     help="the surviving bench (ROADMAP item 1): retry "
                          "backend init on the backoff ladder until the "
@@ -2081,7 +2445,7 @@ def main() -> int:
         if (args.all or args.hbm or args.prod_hbm or args.breakdown
                 or args.train or args.serve or args.superstep is not None
                 or args.kernels is not None or args.sebulba
-                or args.population is not None):
+                or args.population is not None or args.lattice):
             ap.error("--daemon runs the full A/B matrix itself "
                      "(--superstep 4, --kernels ab, --sebulba, --serve "
                      "when --artifact is given); drop the per-leg flags")
@@ -2145,12 +2509,18 @@ def main() -> int:
                      "loop — measure it with --superstep)")
         if (args.all or args.hbm or args.prod_hbm or args.breakdown
                 or args.train or args.serve or args.superstep is not None
-                or args.kernels is not None or args.sebulba
                 or args.config == 5):
             ap.error("--population measures the vmapped population "
                      "superstep vs the serialized P-run; drop --all/"
                      "--hbm/--prod-hbm/--breakdown/--train/--serve/"
-                     "--superstep/--kernels/--sebulba/--config 5")
+                     "--superstep/--config 5")
+        if args.kernels == "ab":
+            # graftlattice composes population with ONE kernel mode per
+            # run: the record's A/B is vmapped-vs-serialized, not
+            # xla-vs-pallas
+            ap.error("--population composes with a single kernel mode; "
+                     "pick --kernels pallas or --kernels xla (run both "
+                     "modes as two invocations, or use --lattice)")
         if args.pipeline:
             ap.error("--population amortizes dispatch across the "
                      "member axis already; drop --pipeline")
@@ -2167,6 +2537,31 @@ def main() -> int:
         # the split needs 2 devices; force 2 CPU host devices while jax
         # is still unimported (no-op on hosts that already expose more —
         # the flag only widens the CPU host platform)
+        if "jax" not in sys.modules:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + " --xla_force_host_platform_device_count=2").strip()
+    if args.lattice:
+        if (args.all or args.hbm or args.prod_hbm or args.breakdown
+                or args.train or args.serve or args.superstep is not None
+                or args.kernels is not None or args.sebulba
+                or args.config == 5):
+            ap.error("--lattice runs its own composition matrix "
+                     "(population x pallas / x dp / x sebulba); drop "
+                     "the per-leg flags")
+        if args.pipeline:
+            ap.error("--lattice legs amortize dispatch on their own "
+                     "axes; drop --pipeline")
+        if args.population is None:
+            args.population = 4
+        if args.population % 2:
+            ap.error("--lattice shards the member axis over a 2-device "
+                     "mesh (population-over-dp sub-leg); --population P "
+                     "must be even")
+        # the dp and sebulba sub-legs need 2 devices (same pre-import
+        # widening as --sebulba)
         if "jax" not in sys.modules:
             flags = os.environ.get("XLA_FLAGS", "")
             if "xla_force_host_platform_device_count" not in flags:
@@ -2191,7 +2586,8 @@ def main() -> int:
                               or args.superstep is not None
                               or args.kernels is not None
                               or args.sebulba
-                              or args.population is not None)
+                              or args.population is not None
+                              or args.lattice)
         args.pipeline = 4 if measures_chain else 0
 
     if args.smoke or args.hbm:
@@ -2347,7 +2743,16 @@ def main() -> int:
             print(f"# trace written to {args.profile}", file=sys.stderr,
                   flush=True)
 
-    if args.kernels is not None:
+    if args.lattice:
+        if jax.device_count() < 2:
+            raise SystemExit(
+                "--lattice needs >= 2 devices (a slice, or XLA_FLAGS="
+                "--xla_force_host_platform_device_count=2 "
+                "JAX_PLATFORMS=cpu)")
+        with tracing():
+            return bench_lattice(cfg, _time, args)
+
+    if args.kernels is not None and args.population is None:
         import dataclasses as _dc
 
         from t2omca_tpu.config import KernelsConfig
@@ -2363,7 +2768,7 @@ def main() -> int:
         with tracing():
             return bench_kernels(make_cfg_kernels, _time, args)
 
-    if args.sebulba:
+    if args.sebulba and args.population is None:
         if jax.device_count() < 2:
             raise SystemExit(
                 "--sebulba needs >= 2 devices (a slice, or XLA_FLAGS="
@@ -2377,6 +2782,15 @@ def main() -> int:
             return bench_superstep(cfg, _time, args)
 
     if args.population is not None:
+        if args.sebulba:
+            # graftlattice: population x sebulba lockstep
+            if jax.device_count() < 2:
+                raise SystemExit(
+                    "--population --sebulba needs >= 2 devices (a "
+                    "slice, or XLA_FLAGS=--xla_force_host_platform_"
+                    "device_count=2 JAX_PLATFORMS=cpu)")
+            with tracing():
+                return bench_population_sebulba(cfg, _time, args)
         with tracing():
             return bench_population(cfg, _time, args)
 

@@ -234,6 +234,45 @@ def population_audit_config():
     return cfg.replace(population=PopulationConfig(size=2))
 
 
+def population_kernels_audit_config():
+    """The frozen config for the vmap-over-pallas twin entry
+    (``superstep_pop_pallas`` — run.py's ``_population_twin_programs``):
+    ``kernels_audit_config("pallas")`` with a FIXED P=2 population, so
+    the entry audits the flash kernels UNDER the population vmap at the
+    kernel audit scale (token counts where the logits tensor the flash
+    path eliminates is material — the tiny shared audit scale would
+    measure scaffolding). Neither parent baseline moves: the
+    population-OFF pallas fingerprints (``train_iter_pallas``) and the
+    xla-mode population fingerprint (``superstep_pop``) are built from
+    their own unchanged configs."""
+    from ..config import PopulationConfig
+    cfg = kernels_audit_config("pallas")
+    return cfg.replace(population=PopulationConfig(size=2))
+
+
+_pkctx: Optional[AuditContext] = None
+
+
+def population_kernels_audit_context() -> AuditContext:
+    """Build (once per process) the population×pallas audit context —
+    the ``population_audit_context`` pattern: ``ts_shape`` is the
+    ``(ts, spec)`` PAIR of stacked ``init_population`` avals."""
+    global _pkctx
+    with _ctx_lock:
+        if _pkctx is None:
+            import jax
+
+            from .. import population as graftpop
+            from ..run import Experiment
+            cfg = population_kernels_audit_config()
+            exp = Experiment.build(cfg)
+            ts_shape = jax.eval_shape(
+                lambda: graftpop.init_population(exp, cfg))
+            _pkctx = AuditContext(cfg=cfg, exp=exp, ts_shape=ts_shape,
+                                  superstep_k=AUDIT_SUPERSTEP_K)
+        return _pkctx
+
+
 _pctx: Optional[AuditContext] = None
 
 

@@ -666,7 +666,12 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
             raise ValueError(
                 "dp_devices shards the device-resident replay ring; "
                 "buffer_cpu_only keeps storage in host RAM — pick one")
-        check_dp_divisibility(cfg, cfg.dp_devices)
+        if not cfg.population.size:
+            # Under population-over-dp the mesh shards the leading (P,)
+            # member axis, not episode lanes — the episode-axis invariant
+            # is replaced by P % dp_devices (checked in the population
+            # block below).
+            check_dp_divisibility(cfg, cfg.dp_devices)
     res = cfg.resilience
     if res.nonfinite_tolerance < 0:
         raise ValueError(f"resilience.nonfinite_tolerance must be >= 0 "
@@ -796,7 +801,8 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
             raise ValueError(
                 "sebulba runs the replay ring + train step on the learner "
                 "device set; buffer_cpu_only keeps storage in host RAM — "
-                "pick one")
+                "drop buffer_cpu_only (the learner mesh holds the ring) "
+                "or run the classic loop for host-RAM replay")
         if cfg.dp_devices:
             raise ValueError(
                 "sebulba partitions the visible devices itself (actor + "
@@ -807,18 +813,24 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
                 "sebulba decouples rollout from training onto disjoint "
                 "device sets; the fused superstep re-serializes them into "
                 "one program — pick one (superstep=1 under sebulba)")
-        if cfg.batch_size_run % sb.actor_devices:
-            raise ValueError(
-                f"batch_size_run={cfg.batch_size_run} must be divisible "
-                f"by sebulba.actor_devices={sb.actor_devices} (env lanes "
-                f"shard over the actor mesh)")
-        if cfg.batch_size % sb.learner_devices \
-                or cfg.replay.buffer_size % sb.learner_devices:
-            raise ValueError(
-                f"batch_size={cfg.batch_size} and replay.buffer_size="
-                f"{cfg.replay.buffer_size} must be divisible by "
-                f"sebulba.learner_devices={sb.learner_devices} (replay "
-                f"episodes shard over the learner mesh)")
+        # under a population the (P,) MEMBER axis shards over each set
+        # (whole members per device — the graftlattice placement), not
+        # the env-lane/episode axes, so these tilings only bind at P=0
+        # (the population block below checks P % set size instead)
+        if not cfg.population.size:
+            if cfg.batch_size_run % sb.actor_devices:
+                raise ValueError(
+                    f"batch_size_run={cfg.batch_size_run} must be "
+                    f"divisible by sebulba.actor_devices="
+                    f"{sb.actor_devices} (env lanes shard over the actor "
+                    f"mesh)")
+            if cfg.batch_size % sb.learner_devices \
+                    or cfg.replay.buffer_size % sb.learner_devices:
+                raise ValueError(
+                    f"batch_size={cfg.batch_size} and replay.buffer_size="
+                    f"{cfg.replay.buffer_size} must be divisible by "
+                    f"sebulba.learner_devices={sb.learner_devices} "
+                    f"(replay episodes shard over the learner mesh)")
     pp = cfg.population
     if pp.size < 0:
         raise ValueError(f"population.size must be >= 0 (0 = no "
@@ -828,21 +840,47 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
             raise ValueError(
                 "the population superstep vmaps the device-resident "
                 "replay ring; buffer_cpu_only keeps storage in host RAM "
-                "— pick one")
-        if cfg.dp_devices:
+                "outside any jitted program — drop buffer_cpu_only (the "
+                "vmapped ring already lives on device) or train members "
+                "as separate solo runs")
+        if cfg.dp_devices and pp.size % cfg.dp_devices:
+            # population-over-dp (graftlattice): the leading (P,) member
+            # axis shards over the 'data' mesh — whole members per
+            # device, so P must tile the mesh
             raise ValueError(
-                "population does not compose with dp_devices yet "
-                "(ROADMAP item 2 names sharding the population over dp "
-                "as the composition) — pick one")
+                f"population-over-dp shards the (P,) member axis over "
+                f"the 'data' mesh (whole members per device — members "
+                f"never communicate); population.size={pp.size} is not "
+                f"divisible by dp_devices={cfg.dp_devices} — pick a "
+                f"divisible P or drop dp_devices")
         if cfg.sebulba.actor_devices:
-            raise ValueError(
-                "population vmaps the fused superstep; sebulba decouples "
-                "it onto disjoint device sets — pick one")
-        if cfg.kernels.attention != "xla":
-            raise ValueError(
-                "population does not compose with kernels.attention="
-                "'pallas' yet (vmap over the hand-written kernel grid is "
-                "unvalidated on this JAX) — use the xla lowering")
+            sb_ = cfg.sebulba
+            if sb_.queue_slots != 1 or sb_.staleness != 0:
+                raise ValueError(
+                    f"population x sebulba composes only in LOCKSTEP "
+                    f"(queue_slots=1, staleness=0): the vmapped learner "
+                    f"trains all P members behind the device-resident "
+                    f"queue in publish order, and an overlapped queue "
+                    f"(queue_slots={sb_.queue_slots}, staleness="
+                    f"{sb_.staleness}) would let members act on params "
+                    f"of different staleness — set queue_slots=1 and "
+                    f"staleness=0, or drop one of population/sebulba")
+            if pp.pbt.enabled:
+                raise ValueError(
+                    "population.pbt exploits/explores at the classic "
+                    "loop's checkpoint-save boundary; the decoupled "
+                    "sebulba loop cannot re-salt the actor thread's "
+                    "in-flight rollouts mid-epoch — run PBT under the "
+                    "classic loop (drop sebulba) or disable "
+                    "population.pbt")
+            for what, n in (("actor_devices", sb_.actor_devices),
+                            ("learner_devices", sb_.learner_devices)):
+                if pp.size % n:
+                    raise ValueError(
+                        f"population x sebulba shards the (P,) member "
+                        f"axis over each device set; population.size="
+                        f"{pp.size} is not divisible by sebulba.{what}="
+                        f"{n} — pick a divisible P or shrink the set")
         if cfg.evaluate or cfg.save_replay or cfg.save_animation:
             raise ValueError(
                 "population trains P stacked members; the evaluate/"

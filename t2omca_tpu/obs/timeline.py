@@ -37,7 +37,7 @@ from ..utils.ioutil import read_jsonl_tolerant
 #: record keys surfaced in the note column when present — the leg
 #: identity that distinguishes one matrix record from another
 _CONTEXT_KEYS = ("config", "superstep", "kernels", "acting", "dp",
-                 "sebulba", "leg", "n_envs")
+                 "population", "sebulba", "leg", "n_envs")
 
 
 def _warn(msg: str) -> None:

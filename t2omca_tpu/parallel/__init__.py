@@ -1,5 +1,6 @@
 from .distributed import maybe_initialize_distributed
-from .mesh import DataParallel, make_mesh, partition_devices
+from .mesh import (DataParallel, make_mesh, partition_devices,
+                   population_shardings)
 
 __all__ = ["make_mesh", "partition_devices", "DataParallel",
-           "maybe_initialize_distributed"]
+           "population_shardings", "maybe_initialize_distributed"]

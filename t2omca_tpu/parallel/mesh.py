@@ -66,6 +66,26 @@ def partition_devices(n_actor: int, n_learner: int,
     return tuple(devs[:n_actor]), tuple(devs[n_actor:need])
 
 
+def population_shardings(mesh: Mesh, tree_like, axis: str = "data"):
+    """NamedSharding pytree for population-over-dp (graftlattice): every
+    leaf of the (P,)-stacked population state — TrainState halves AND the
+    ``PopulationSpec`` — sharded on its LEADING member axis over the mesh.
+
+    This is deliberately simpler than ``DataParallel.state_shardings``:
+    the population superstep vmaps over members and members never
+    communicate, so the mesh cuts between whole members (P must divide
+    the axis size — ``sanity_check`` enforces it) and no leaf needs a
+    per-field placement rule. Replicated-vs-sharded parity: no
+    cross-member collective is ever inserted, so control/integer state
+    is bit-equal; float leaves sit at f32 ULP scale, NOT bitwise —
+    partitioning retiles the batched reduces (batch-P arrays on one
+    device vs batch-P/n shards), measured ~1e-7 absolute / up to
+    2.4e-5 rel on small adam moments after a train step
+    (tests/test_lattice.py)."""
+    member = NamedSharding(mesh, P(axis))
+    return jax.tree.map(lambda _: member, tree_like)
+
+
 @dataclasses.dataclass(frozen=True)
 class DataParallel:
     """Sharded program wrapper for an ``Experiment`` (``run.Experiment``).
@@ -236,22 +256,62 @@ AUDIT_MESH_DEVICES = 2
 def register_audit_programs(ctx):
     """graftprog registry hook: the data-parallel superstep under a
     fixed ``AUDIT_MESH_DEVICES``-wide mesh (fingerprints must not vary
-    with the host's device count). Skipped — never failed — on hosts
-    exposing fewer CPU devices."""
+    with the host's device count), plus the population-over-dp twin
+    (graftlattice — the member axis sharded over the same mesh).
+    Skipped — never failed — on hosts exposing fewer CPU devices."""
     from ..analysis.registry import AuditProgram
     import jax.numpy as jnp
     if len(jax.devices()) < AUDIT_MESH_DEVICES:
-        return {"dp_superstep": AuditProgram.skipped(
+        skip = AuditProgram.skipped(
             f"needs >= {AUDIT_MESH_DEVICES} devices (hint: XLA_FLAGS="
             f"--xla_force_host_platform_device_count="
-            f"{AUDIT_MESH_DEVICES})")}
+            f"{AUDIT_MESH_DEVICES})")
+        return {"dp_superstep": skip, "pop_dp_superstep": skip}
     dp = DataParallel(ctx.exp, make_mesh(AUDIT_MESH_DEVICES))
     k = ctx.superstep_k
     sup = dp.superstep_program(k, donate=True)
     key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
     keys = jax.ShapeDtypeStruct((k,) + key.shape, key.dtype)
-    return {"dp_superstep": AuditProgram(
-        sup, (dp.audit_avals(ctx.ts_shape), keys, jnp.asarray(0)),
+    return {
+        "dp_superstep": AuditProgram(
+            sup, (dp.audit_avals(ctx.ts_shape), keys, jnp.asarray(0)),
+            donate_argnums=(0,),
+            description=f"fused K={k} superstep sharded over a "
+                        f"{AUDIT_MESH_DEVICES}-device data axis"),
+        **_pop_dp_twin(k, key),
+    }
+
+
+def _pop_dp_twin(k, key):
+    """The population-over-dp audit entry (graftlattice): the SAME
+    ``superstep_pop`` program (``run.population_superstep_program``,
+    P=2 population audit scale) lowered with every state/spec leaf
+    annotated with its ``population_shardings`` member-axis placement —
+    the SPMD executable ``run_sequential`` dispatches when
+    ``population.size`` and ``dp_devices`` are both set. Unsharded avals
+    would lower the single-device ``superstep_pop`` again and the
+    recorded budgets would be fiction (the ``DataParallel.audit_avals``
+    rationale)."""
+    from ..analysis.registry import AuditProgram, population_audit_context
+    pctx = population_audit_context()
+    mesh = make_mesh(AUDIT_MESH_DEVICES)
+    p, kk = pctx.cfg.population.size, pctx.superstep_k
+    ts_shape, spec_shape = pctx.ts_shape
+
+    def annotate(tree):
+        return jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                               sharding=sh),
+            tree, population_shardings(mesh, tree))
+
+    keys = jax.ShapeDtypeStruct((p, kk) + key.shape, key.dtype)
+    prog = pctx.exp.population_superstep_program(kk, donate=True)
+    import jax.numpy as jnp
+    return {"pop_dp_superstep": AuditProgram(
+        prog, (annotate(ts_shape), annotate(keys), jnp.asarray(0),
+               annotate(spec_shape)),
         donate_argnums=(0,),
-        description=f"fused K={k} superstep sharded over a "
-                    f"{AUDIT_MESH_DEVICES}-device data axis")}
+        description=f"fused K={kk} population superstep with the P={p} "
+                    f"member axis sharded over a {AUDIT_MESH_DEVICES}-"
+                    f"device data axis (population-over-dp: whole "
+                    f"members per device, no cross-member collectives)")}

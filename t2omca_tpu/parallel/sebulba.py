@@ -60,9 +60,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..components.episode_buffer import BufferState, TimeMajorEpisodes
 from ..learners.qmix_learner import LearnerState
 # one source for the weak_type-stripping invariant (run.py's chained-
-# output retrace guard); run.py imports nothing from parallel/ at module
-# level, so this is cycle-free
-from ..run import _strong
+# output retrace guard) and the graftpop P=1 bit-parity bridge (squeeze
+# the member axis inside the jit, restore it on the way out); run.py
+# imports nothing from parallel/ at module level, so this is cycle-free
+from ..run import _expand0, _squeeze0, _strong
 
 
 @struct.dataclass
@@ -108,6 +109,17 @@ class Sebulba:
     ``DataParallel``, applied per set. Size-1 sets reduce to plain
     single-device placement, so the 1+1 smoke/lockstep configs pay no
     SPMD machinery.
+
+    ``population=P`` (graftlattice, docs/POPULATION.md §composition)
+    stacks a leading ``(P,)`` member axis on EVERY state/emission leaf
+    and swaps the placement rule: the member axis shards over each
+    mesh (whole members per device — members never communicate) and
+    the programs vmap the same bodies over it. ``spec`` is the
+    concrete :class:`~t2omca_tpu.population.PopulationSpec` baked into
+    the programs as a closure constant — legal because PBT (the only
+    spec mutator) is rejected under sebulba, so the spec is static for
+    the life of the run. P=1 squeezes instead of vmapping (the
+    population bit-parity bridge).
     """
 
     exp: object                 # run.Experiment (duck-typed, avoids cycle)
@@ -115,10 +127,13 @@ class Sebulba:
     learner_mesh: Mesh
     queue_slots: int
     axis: str = "data"
+    population: int = 0         # P members; 0 = no population axis
+    spec: object = None         # PopulationSpec (static — PBT rejected)
 
     @classmethod
     def build(cls, exp, actor_devices: Sequence, learner_devices: Sequence,
-              queue_slots: int) -> "Sebulba":
+              queue_slots: int, population: int = 0,
+              spec: object = None) -> "Sebulba":
         if set(actor_devices) & set(learner_devices):
             raise ValueError("actor and learner device sets must be "
                              "disjoint — overlap re-serializes the phases "
@@ -126,21 +141,37 @@ class Sebulba:
         if queue_slots < 1:
             raise ValueError(f"queue_slots must be >= 1, got {queue_slots}")
         cfg = exp.cfg
-        if cfg.batch_size_run % len(actor_devices):
-            raise ValueError(
-                f"batch_size_run={cfg.batch_size_run} must divide over "
-                f"{len(actor_devices)} actor devices")
-        if (cfg.batch_size % len(learner_devices)
-                or cfg.replay.buffer_size % len(learner_devices)):
-            raise ValueError(
-                f"batch_size={cfg.batch_size} and replay.buffer_size="
-                f"{cfg.replay.buffer_size} must divide over "
-                f"{len(learner_devices)} learner devices")
+        if population:
+            # the (P,) MEMBER axis shards over each set — whole members
+            # per device — so P must tile each mesh; the env-lane and
+            # episode-axis tilings below only bind the solo layout
+            if spec is None:
+                raise ValueError("population > 0 requires the concrete "
+                                 "PopulationSpec (build_spec(cfg))")
+            for what, devs in (("actor", actor_devices),
+                               ("learner", learner_devices)):
+                if population % len(devs):
+                    raise ValueError(
+                        f"population={population} must divide over "
+                        f"{len(devs)} {what} devices (member-axis "
+                        f"sharding)")
+        else:
+            if cfg.batch_size_run % len(actor_devices):
+                raise ValueError(
+                    f"batch_size_run={cfg.batch_size_run} must divide over "
+                    f"{len(actor_devices)} actor devices")
+            if (cfg.batch_size % len(learner_devices)
+                    or cfg.replay.buffer_size % len(learner_devices)):
+                raise ValueError(
+                    f"batch_size={cfg.batch_size} and replay.buffer_size="
+                    f"{cfg.replay.buffer_size} must divide over "
+                    f"{len(learner_devices)} learner devices")
         return cls(exp=exp,
                    actor_mesh=Mesh(np.asarray(actor_devices), ("data",)),
                    learner_mesh=Mesh(np.asarray(learner_devices),
                                      ("data",)),
-                   queue_slots=int(queue_slots))
+                   queue_slots=int(queue_slots),
+                   population=int(population), spec=spec)
 
     # ------------------------------------------------------------ shardings
 
@@ -151,7 +182,11 @@ class Sebulba:
         """Actor-mesh placement for the runner state: env lanes sharded,
         key/t_env replicated, reward-scale per-ndim (the
         ``DataParallel.state_shardings`` runner rules, on the actor
-        mesh)."""
+        mesh). Under a population EVERY leaf is ``(P,)``-stacked and
+        shards uniformly on its leading member axis instead."""
+        if self.population:
+            member = self._sh(self.actor_mesh, self.axis)
+            return jax.tree.map(lambda _: member, rs_like)
         lane = self._sh(self.actor_mesh, self.axis)
         rep = self._sh(self.actor_mesh)
         return rs_like.replace(
@@ -168,7 +203,12 @@ class Sebulba:
         """Learner-mesh placement: params/opt replicated (grads psum'd by
         GSPMD when the loss averages over a sharded batch), replay
         episodes sharded, PER bookkeeping replicated — the
-        ``DataParallel`` buffer rules, on the learner mesh."""
+        ``DataParallel`` buffer rules, on the learner mesh. Under a
+        population: uniform leading-member-axis sharding (params and
+        ring alike — whole members per device)."""
+        if self.population:
+            member = self._sh(self.learner_mesh, self.axis)
+            return jax.tree.map(lambda _: member, ls_like)
         ep = self._sh(self.learner_mesh, self.axis)
         rep = self._sh(self.learner_mesh)
         buffer = ls_like.buffer.replace(
@@ -183,7 +223,13 @@ class Sebulba:
         """Placement for a ``TimeMajorEpisodes`` pytree (or the queue's
         slot-stacked form with ``leading=1``): the batch axis shards
         over ``mesh`` — axis ``leading+1`` for the time-major scan
-        leaves, axis ``leading`` for the bootstrap ``last_*`` leaves."""
+        leaves, axis ``leading`` for the bootstrap ``last_*`` leaves.
+        Under a population the MEMBER axis (position ``leading``:
+        emissions are ``(P, T, B, ...)``, queue slots ``(S, P, T, B,
+        ...)``) shards instead, uniformly for every leaf."""
+        if self.population:
+            member = self._sh(mesh, *((None,) * leading), self.axis)
+            return jax.tree.map(lambda _: member, tm_like)
         seq = self._sh(mesh, *((None,) * (leading + 1)), self.axis)
         last = self._sh(mesh, *((None,) * leading), self.axis)
 
@@ -202,12 +248,21 @@ class Sebulba:
             last_avail=fill(tm_like.last_avail, last))
 
     def params_sharding(self):
-        """Actor-mesh replication for the published acting params."""
+        """Actor-mesh placement for the published acting params:
+        replicated solo, member-axis-sharded under a population (the
+        published stack is ``(P, ...)`` per leaf)."""
+        if self.population:
+            return self._sh(self.actor_mesh, self.axis)
         return self._sh(self.actor_mesh)
 
     # ------------------------------------------------------------ state
 
     def _state_shapes(self, seed: int):
+        if self.population:
+            from .. import population as graftpop
+            return jax.eval_shape(
+                lambda: graftpop.init_population(self.exp,
+                                                 self.exp.cfg))[0]
         return jax.eval_shape(lambda: self.exp.init_train_state(seed))
 
     def split_shapes(self, ts_like) -> Tuple[object, object]:
@@ -224,9 +279,23 @@ class Sebulba:
         no full-state single-device transient ever exists (the
         ``DataParallel.init_sharded`` reasoning). Both builds run the
         same deterministic ``init_train_state(seed)``, so the halves are
-        consistent."""
+        consistent. Under a population both builds run
+        ``graftpop.init_population`` instead (P explicit solo inits
+        stacked — member i bit-identical to a solo init at seed_i; the
+        spec half is dead code the jit DCEs)."""
         shapes = self._state_shapes(seed)
         rs_shape, ls_shape = self.split_shapes(shapes)
+        if self.population:
+            from .. import population as graftpop
+            cfg = self.exp.cfg
+            rs = jax.jit(
+                lambda: graftpop.init_population(self.exp, cfg)[0].runner,
+                out_shardings=self.runner_shardings(rs_shape))()
+            ls = jax.jit(
+                lambda: self.split_shapes(
+                    graftpop.init_population(self.exp, cfg)[0])[1],
+                out_shardings=self.learner_shardings(ls_shape))()
+            return rs, ls
         rs = jax.jit(
             lambda: self.exp.init_train_state(seed).runner,
             out_shardings=self.runner_shardings(rs_shape))()
@@ -254,12 +323,18 @@ class Sebulba:
 
     def tm_abstract(self):
         """eval_shape of the rollout scan's time-major emission (the
-        queue slot payload)."""
-        shapes = self._state_shapes(self.exp.cfg.seed)
+        queue slot payload) — ``(P,)``-stacked per leaf under a
+        population (one member's emission, batched by the actor vmap)."""
+        shapes = jax.eval_shape(
+            lambda: self.exp.init_train_state(self.exp.cfg.seed))
         params = shapes.learner.params["agent"]
         _, tm, _ = jax.eval_shape(
             lambda p, r: self.exp.runner.run_raw(p, r, test_mode=False),
             params, shapes.runner)
+        if self.population:
+            return jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct(
+                    (self.population,) + l.shape, l.dtype), tm)
         return tm
 
     def init_queue(self) -> QueueState:
@@ -312,6 +387,15 @@ class Sebulba:
           the exact ``run.Experiment.jitted_programs._train_iter``
           arithmetic (sample → train → non-finite-guarded priority
           feedback) on the learner-side state.
+
+        Under ``population=P`` the same bodies vmap over the leading
+        member axis (per-member key column ``(P, 2)`` into the learner
+        step; ``t_env`` stays a shared scalar), mirroring
+        ``run.Experiment.population_superstep_program``: P=1 squeezes
+        through the UNBATCHED body (bit-parity — a batched rank
+        reassociates f32 reduces), and a statically NEUTRAL P=1 spec
+        drops the spec seams entirely (``spec=None`` into the body, the
+        fusion-sensitivity gotcha).
         """
         exp = self.exp
         runner, buffer, learner, cfg = (exp.runner, exp.buffer, exp.learner,
@@ -320,14 +404,50 @@ class Sebulba:
         rs_c = lambda rs: self.runner_shardings(rs)
         ls_c = lambda ls: self.learner_shardings(ls)
         batch_sh = self._sh(self.learner_mesh, self.axis)
+        pop, spec = self.population, self.spec
+        pc = cfg.population
+        neutral = (pop == 1 and not pc.lr and not pc.eps_scale
+                   and not pc.per_alpha and not pc.scenario_salt
+                   and not pc.pbt.enabled)
+
+        def _roll_one(params, rs, test_mode, s):
+            # one member's rollout: the spec's epsilon scale (and
+            # scenario salt) thread in exactly like the classic
+            # population superstep body; greedy test rollouts take no
+            # spec seams (population_rollout_program's shape)
+            roll_kw = {}
+            if s is not None and not test_mode:
+                roll_kw["eps_scale"] = s.eps_scale
+                if pc.scenario_salt:
+                    roll_kw["member"] = s.member
+            rs2, tm, stats = runner.run_raw(params, rs,
+                                            test_mode=test_mode, **roll_kw)
+            return _strong(rs2), tm, stats
 
         def _actor_step(params, rs, test_mode):
-            rs2, tm, stats = runner.run_raw(params, rs,
-                                            test_mode=test_mode)
+            if pop == 1:
+                r2, tm, stats = _roll_one(
+                    _squeeze0(params), _squeeze0(rs), test_mode,
+                    None if neutral else _squeeze0(spec))
+                rs2, tm, stats = (_expand0(r2), _expand0(tm),
+                                  _expand0(stats))
+            elif pop:
+                rs2, tm, stats = jax.vmap(
+                    lambda p, r, s: _roll_one(p, r, test_mode, s))(
+                        params, rs, spec)
+            else:
+                # solo path verbatim (run_raw -> wsc -> _strong op
+                # order): the audited actor_step fingerprint is pinned
+                rs2, tm, stats = runner.run_raw(params, rs,
+                                                test_mode=test_mode)
+                rs2 = jax.tree.map(wsc, rs2, rs_c(rs2))
+                tm = jax.tree.map(wsc, tm, self.tm_shardings(
+                    tm, self.actor_mesh))
+                return _strong(rs2), tm, stats
             rs2 = jax.tree.map(wsc, rs2, rs_c(rs2))
             tm = jax.tree.map(wsc, tm, self.tm_shardings(
                 tm, self.actor_mesh))
-            return _strong(rs2), tm, stats
+            return rs2, tm, stats
 
         actor_step = jax.jit(_actor_step, static_argnames="test_mode")
 
@@ -346,33 +466,68 @@ class Sebulba:
                 lambda s: jax.lax.dynamic_index_in_dim(s, slot, 0,
                                                        keepdims=False),
                 q.slots)
-            buf = buffer.insert_time_major(ls.buffer, tm)
+            if pop == 1:
+                buf = _expand0(buffer.insert_time_major(
+                    _squeeze0(ls.buffer), _squeeze0(tm),
+                    alpha=None if neutral
+                    else jnp.squeeze(spec.per_alpha, 0)))
+            elif pop:
+                # per-member PER exponent into the ring writes, like the
+                # classic population superstep's insert
+                buf = jax.vmap(
+                    lambda b, t, a: buffer.insert_time_major(
+                        b, t, alpha=a))(ls.buffer, tm, spec.per_alpha)
+            else:
+                buf = buffer.insert_time_major(ls.buffer, tm)
             ls = ls.replace(buffer=buf,
                             episode=ls.episode + cfg.batch_size_run)
             return _strong(jax.tree.map(wsc, ls, ls_c(ls))), q
 
         queue_get = jax.jit(_queue_get, donate_argnums=(0, 1))
 
-        def _learner_step(ls: LearnerSideState, key: jax.Array,
-                          t_env: jnp.ndarray):
-            # identical arithmetic + key threading to run._train_iter —
-            # the lockstep bit-parity anchor depends on it
+        def _train_core(ls: LearnerSideState, key: jax.Array,
+                        t_env: jnp.ndarray, s):
+            # identical arithmetic + key threading to run._train_iter /
+            # run._superstep_fn._train — the lockstep bit-parity anchors
+            # (solo AND population) depend on it
             k_sample, k_learn = jax.random.split(key)
             batch, idx, weights = buffer.sample(
                 ls.buffer, k_sample, cfg.batch_size, t_env)
-            batch = jax.tree.map(lambda x: wsc(x, batch_sh), batch)
+            if not pop:
+                # episode-axis constraint — solo layout only (invalid
+                # inside the member vmap; the stacked output takes the
+                # member-axis constraint below instead)
+                batch = jax.tree.map(lambda x: wsc(x, batch_sh), batch)
             learner_state, info = learner.train(
-                ls.learner, batch, weights, t_env, ls.episode, k_learn)
+                ls.learner, batch, weights, t_env, ls.episode, k_learn,
+                spec=s)
             buf = buffer.update_priorities(
                 ls.buffer, idx, info["td_errors_abs"] + 1e-6,      # Q9
-                valid=info["all_finite"])
+                valid=info["all_finite"],
+                alpha=None if s is None else s.per_alpha)
             # graftsight PER health (run._train_iter's in-graph read,
             # re-homed with the rest of this program — the one shared
             # definition keeps the emitted pytrees in sync)
             from ..obs import sight as graftsight
             info = graftsight.maybe_buffer_info(cfg, info, buf)
-            ls = ls.replace(learner=learner_state, buffer=buf)
-            return _strong(jax.tree.map(wsc, ls, ls_c(ls))), info
+            return ls.replace(learner=learner_state, buffer=buf), info
+
+        def _learner_step(ls: LearnerSideState, key: jax.Array,
+                          t_env: jnp.ndarray):
+            if pop == 1:
+                l2, info = _train_core(
+                    _squeeze0(ls), jnp.squeeze(key, 0), t_env,
+                    None if neutral else _squeeze0(spec))
+                ls2, info = _expand0(l2), _expand0(info)
+            elif pop:
+                # per-member (2,) key columns; t_env stays the shared
+                # scalar (counters evolve identically across members)
+                ls2, info = jax.vmap(
+                    lambda l, k, s: _train_core(l, k, t_env, s))(
+                        ls, key, spec)
+            else:
+                ls2, info = _train_core(ls, key, t_env, None)
+            return _strong(jax.tree.map(wsc, ls2, ls_c(ls2))), info
 
         learner_step = jax.jit(_learner_step, donate_argnums=(0,))
         return actor_step, queue_put, queue_get, learner_step
@@ -381,11 +536,19 @@ class Sebulba:
 def make_sebulba(exp) -> Sebulba:
     """Build the Sebulba machinery from ``exp.cfg.sebulba`` (the driver
     entry): partition the visible devices into the configured disjoint
-    sets and size the queue."""
+    sets and size the queue; a configured population rides in with its
+    concrete spec (sanity_check already restricted the combo to
+    lockstep with PBT off, so the spec is static)."""
     from .mesh import partition_devices
     sb = exp.cfg.sebulba
     actor, learner = partition_devices(sb.actor_devices, sb.learner_devices)
-    return Sebulba.build(exp, actor, learner, sb.queue_slots)
+    pop = int(exp.cfg.population.size)
+    spec = None
+    if pop:
+        from .. import population as graftpop
+        spec = graftpop.build_spec(exp.cfg)
+    return Sebulba.build(exp, actor, learner, sb.queue_slots,
+                         population=pop, spec=spec)
 
 
 #: the fixed audit split (2 actor + 2 learner devices) the registered
@@ -409,7 +572,8 @@ def register_audit_programs(ctx):
         skip = AuditProgram.skipped(
             f"needs >= {need} devices (hint: XLA_FLAGS="
             f"--xla_force_host_platform_device_count={need})")
-        return {"actor_step": skip, "learner_step": skip}
+        return {"actor_step": skip, "learner_step": skip,
+                "pop_learner_step": skip}
     from .mesh import partition_devices
     actor, learner = partition_devices(n_actor, n_learner)
     seb = Sebulba.build(ctx.exp, actor, learner, queue_slots=2)
@@ -430,6 +594,24 @@ def register_audit_programs(ctx):
     ls = annotate(ls_shape, seb.learner_shardings(ls_shape))
     key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
     t_env = jnp.asarray(0)          # weak-typed, like the driver's
+    # population x sebulba lockstep twin (graftlattice): the vmapped
+    # sample->train->priority step behind the queue — its own Sebulba
+    # at the population audit scale (P=2 members over the 2-device
+    # learner mesh, lockstep queue), so the solo actor/learner
+    # baselines above stay byte-identical
+    from ..analysis.registry import population_audit_context
+    from .. import population as graftpop
+    pctx = population_audit_context()
+    p = int(pctx.cfg.population.size)
+    pseb = Sebulba.build(pctx.exp, actor, learner, queue_slots=1,
+                         population=p,
+                         spec=graftpop.build_spec(pctx.cfg))
+    _, _, _, pop_learner_step = pseb.programs()
+    # the population context's ts_shape is the stacked (ts, spec) pair
+    pts_shape, _pspec_shape = pctx.ts_shape
+    _, pls_shape = pseb.split_shapes(pts_shape)
+    pls = annotate(pls_shape, pseb.learner_shardings(pls_shape))
+    pkeys = jax.ShapeDtypeStruct((p,) + key.shape, key.dtype)
     return {
         "actor_step": AuditProgram(
             actor_step, (params, rs), kwargs=dict(test_mode=False),
@@ -439,4 +621,11 @@ def register_audit_programs(ctx):
             learner_step, (ls, key, t_env), donate_argnums=(0,),
             description=f"sebulba sample->train->priority step re-homed "
                         f"onto a {n_learner}-device learner mesh"),
+        "pop_learner_step": AuditProgram(
+            pop_learner_step, (pls, pkeys, t_env), donate_argnums=(0,),
+            description=f"population x sebulba lockstep learner step: "
+                        f"P={p} members vmapped behind the trajectory "
+                        f"queue, member axis sharded over the "
+                        f"{n_learner}-device learner mesh "
+                        f"(graftlattice)"),
     }

@@ -626,7 +626,8 @@ def _population_twin_programs(key, t_env):
     re-baseline, pinned by the t1 prelude)."""
     import jax as _jax
 
-    from .analysis.registry import AuditProgram, population_audit_context
+    from .analysis.registry import (AuditProgram, population_audit_context,
+                                    population_kernels_audit_context)
     pctx = population_audit_context()
     exp, k = pctx.exp, pctx.superstep_k
     p = pctx.cfg.population.size
@@ -635,6 +636,15 @@ def _population_twin_programs(key, t_env):
     ts_shape, spec_shape = pctx.ts_shape
     prog = exp.population_superstep_program(k, donate=True)
     keys = _jax.ShapeDtypeStruct((p, k) + key.shape, key.dtype)
+    # vmap-over-pallas twin (graftlattice): the same population program
+    # under kernels.attention=pallas at the KERNEL audit scale — its own
+    # context, so neither the xla-mode population baseline above nor the
+    # population-OFF pallas baselines move a byte
+    pkctx = population_kernels_audit_context()
+    pk_ts, pk_spec = pkctx.ts_shape
+    pk_prog = pkctx.exp.population_superstep_program(k, donate=True)
+    pk_keys = _jax.ShapeDtypeStruct((pkctx.cfg.population.size, k)
+                                    + key.shape, key.dtype)
     return {
         "superstep_pop": AuditProgram(
             prog, (ts_shape, keys, t_env, spec_shape),
@@ -643,7 +653,268 @@ def _population_twin_programs(key, t_env):
                         f"population (graftpop — one donated dispatch "
                         f"advances P members; per-member lr/eps/alpha "
                         f"spec leaves)"),
+        "superstep_pop_pallas": AuditProgram(
+            pk_prog, (pk_ts, pk_keys, t_env, pk_spec),
+            donate_argnums=(0,),
+            description=f"fused K={k} population superstep with the "
+                        f"flash attention kernels vmapped over the "
+                        f"P={pkctx.cfg.population.size} member axis "
+                        f"(vmap-over-pallas, kernel audit scale — "
+                        f"populations use the fused forward+backward "
+                        f"kernels)"),
     }
+
+
+def _host_int(x) -> int:
+    """Host mirror of a control counter. Under a population the counter
+    is (P,)-stacked but every member's copy evolves identically (same
+    batch_size_run, capacity, gates), so member 0's value mirrors the
+    whole stacked pytree."""
+    return int(np.asarray(jax.device_get(x)).reshape(-1)[0])
+
+
+class _DriverKit:
+    """Shared driver-helper kit (graftlattice, ROADMAP item 2): the
+    watchdog stamps, fault-handled dispatch, sync-point classification,
+    stall response, flight persist and bounded save-lock discipline that
+    ``run_sequential`` and ``run_sebulba`` previously carried as
+    acknowledged forked copies (PR 10 known debt). One instance per
+    driver; each loop binds locals (``_watched = kit.watched`` …) so
+    graftlint's name-keyed call-site phase checks (GL110), the
+    fault-injection hooks and the tests see the same wrapper names
+    either way.
+
+    Parameterization points — the only behavioral deltas the two loops
+    ever had:
+
+    * ``default_wd`` — the watchdog a bare ``watched``/``dispatch``
+      call stamps with. The classic loop arms its single watchdog here
+      (every device-facing region stamps by default); the sebulba loop
+      leaves it ``None`` and passes ``awd=`` explicitly per thread (one
+      armed stamp per instance — concurrent threads must not share
+      one), so its span-only sites (queue waits bounded by the PEER's
+      progress, not device health) stay unstamped.
+    * ``t_env_fn`` — the classic loop's cursor closure for sites that
+      don't pass ``t=`` explicitly; sebulba always passes ``t=`` from
+      whichever thread's cursor applies.
+    * ``wake`` — sebulba's queue-condition notifier, fired inside the
+      stall response so threads blocked on the queue observe the guard
+      trip; ``None`` classically.
+    * ``P``/``spec_fn`` — the population stamp wrap: the watchdog's
+      emergency save writes the stamped state verbatim, and a bare
+      (P,)-stacked TrainState would hit the single-member→population
+      migration shim on restore and double-stack, so any full
+      TrainState stamp is wrapped into the checkpointable ``PopState``
+      (runner-state-only and learner-half stamps pass through — they
+      are never emergency-saved).
+    """
+
+    _UNSET = object()
+
+    def __init__(self, *, cfg, res, log, rec, mw, sight_mon, guard,
+                 model_dir, save_lock, P=0, spec_fn=None, wake=None):
+        self.cfg, self.res, self.log, self.rec = cfg, res, log, rec
+        self.mw, self.sight_mon, self.guard = mw, sight_mon, guard
+        self.model_dir, self.save_lock = model_dir, save_lock
+        self.P, self.spec_fn, self.wake = P, spec_fn, wake
+        self.default_wd = None      # armed by the driver once built
+        self.t_env_fn = lambda: 0   # the classic loop re-binds its cursor
+        self.dispatch_faults = 0    # transient dispatch errors seen (stats)
+
+    # ------------------------------------------------------------ telemetry
+
+    def persist_flight(self, path: str) -> None:
+        """Flight persist + the memwatch high-water + sight-verdict
+        blocks (cached state only — safe on crash/stall paths over a
+        wedged backend)."""
+        extra = {}
+        if self.mw.enabled:
+            extra["memwatch"] = self.mw.report()
+        if self.sight_mon is not None:
+            extra["sight"] = self.sight_mon.report()
+        self.rec.persist(path, extra=extra or None)
+
+    def watched(self, phase, state=None, awd=_UNSET, t=None, **meta):
+        """One watchdog stamp + graftscope span for a device-facing
+        region (no-op context when both are disabled) — keeps the
+        wd-None guard, the current-t_env threading, and the telemetry
+        pairing in one place instead of at every site. ``meta`` lands
+        in the span event (attempt counts, K); the watchdog stamp is
+        the OUTER context so a hang inside the span bookkeeping is
+        still bounded."""
+        if awd is _DriverKit._UNSET:
+            awd = self.default_wd
+        if t is None:
+            t = self.t_env_fn()
+        if (self.P and state is not None and hasattr(state, "runner")
+                and not hasattr(state, "spec")):
+            # population runs stamp the CHECKPOINTABLE PopState, never
+            # the bare stacked TrainState (class docstring)
+            from . import population as graftpop
+            state = graftpop.PopState(ts=state, spec=self.spec_fn())
+        w = (awd.watch(phase, t_env=t, state=state)
+             if awd is not None else None)
+        if self.rec.enabled:
+            s = self.rec.span(phase, t_env=t, **meta)
+            return obs_spans.stacked(w, s) if w is not None else s
+        return w if w is not None else nullcontext()
+
+    # ------------------------------------------------------------ dispatch
+
+    def dispatch(self, phase, fn, state, awd=_UNSET, t=None,
+                 retryable=True, **context):
+        """One device-facing dispatch: fault-injection hook + watchdog
+        heartbeat + bounded in-place retry with backoff (ladder rung 0).
+        Transient-classified failures retry ``fn`` with the SAME inputs —
+        the callers commit their host mirrors only after success, so a
+        retry replays an identical dispatch. Pass ``retryable=False``
+        when ``fn`` carries non-idempotent HOST side effects the
+        commit-after-success discipline cannot cover (the host-buffer
+        path: ``buffer.sample()`` advances the host RNG and the ring
+        insert mutates host RAM before a transient h2d/sync failure
+        surfaces, and ``state_intact`` can't see host mutations — a
+        retry would train on a different batch or double-insert); the
+        first transient failure then goes straight to the ladder.
+        Deterministic errors propagate immediately (retrying a shape bug
+        only delays the real diagnosis); exhausted retries — or a
+        failure that already consumed the donated state — raise
+        DispatchFailed for the ladder. Deliberately NOT composed from
+        watchdog.retry_call: the per-attempt stamp+fire, the donation
+        check, and the exhaustion→DispatchFailed conversion don't fit
+        its propagate-last-error contract."""
+        res = self.res
+        if t is None:
+            t = self.t_env_fn()
+        attempts = (1 + res.dispatch_retries) if retryable else 1
+        for attempt in range(1, attempts + 1):
+            try:
+                with self.watched(phase, state, awd=awd, t=t,
+                                  attempt=attempt, **context):
+                    # the hook fires INSIDE the watched region: an
+                    # injected sleep here is indistinguishable from a
+                    # hung dispatch to the watchdog (tests rely on this)
+                    resilience.fire(phase, t_env=t, attempt=attempt,
+                                    **context)
+                    return fn()
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not watchdog.is_transient(e):
+                    raise
+                self.dispatch_faults += 1
+                if attempt >= attempts or not watchdog.state_intact(state):
+                    raise watchdog.DispatchFailed(phase, attempt, e) from e
+                delay = watchdog.backoff_delay(attempt, res.retry_backoff_s)
+                self.log.warning(f"{phase}: transient dispatch failure "
+                                 f"(attempt {attempt}/{attempts}), "
+                                 f"retrying in {delay:.2f}s: "
+                                 f"{type(e).__name__}: {e}")
+                time.sleep(delay)
+
+    def sync_point(self, phase, fn, state):
+        """One blocking sync/fetch boundary (run-ahead wait, cadence stat
+        fetch): watchdog stamp + fault-injection hook + transient
+        classification in one place. On the production path these host
+        round-trips are where a device-side wedge or async fault
+        actually surfaces, so each must carry a stamp — an unstamped
+        blocking fetch is exactly the silent hang this layer exists to
+        bound. No in-place retry is possible here (the already-
+        dispatched computation's donated inputs are gone and its
+        outputs are suspect), so a transient failure raises
+        ``DispatchFailed`` for the caller to route to the ladder with
+        ``can_degrade=False`` — restore is the only rung that can
+        stand; deterministic errors propagate unwrapped."""
+        try:
+            with self.watched(phase, state):
+                resilience.fire(phase, t_env=self.t_env_fn())
+                return fn()
+        except Exception as e:  # noqa: BLE001 — classified below
+            if not watchdog.is_transient(e):
+                raise
+            self.dispatch_faults += 1
+            raise watchdog.DispatchFailed(phase, 1, e) from e
+
+    # ------------------------------------------------------------ stalls
+
+    def acquire_save_lock(self, where: str) -> bool:
+        """BOUNDED acquire shared by every save site: an emergency save
+        wedged inside the stalled backend can hold the lock forever, and
+        each waiter (watchdog callback, save cadence, exit path) must
+        skip with a warning instead of inheriting the hang — resume then
+        falls back to the newest published checkpoint."""
+        if self.save_lock.acquire(timeout=max(self.res.stall_grace_s,
+                                              60.0)):
+            return True
+        self.log.warning(f"{where}: checkpoint skipped — an emergency "
+                         f"save still holds the save lock (wedged "
+                         f"backend?); resume falls back to the newest "
+                         f"published checkpoint")
+        return False
+
+    def stall_response(self, diag, tag: str = "watchdog",
+                       save: bool = True) -> None:
+        """The watchdog stall callback: flight tail + memwatch + sight
+        extras folded into the diagnosis write, guard trip (BEFORE the
+        save attempt — the emergency save reads device state over the
+        possibly-wedged backend and can block without raising; with
+        stall_grace_s=0 a guard tripped only afterwards would never
+        trip at all), queue-wait wakeup, then a gated emergency
+        checkpoint from the stamped pre-dispatch state. ``save=False``
+        is the actor-thread shape: diagnosis + guard trip only — the
+        learner (main) thread owns the checkpointable state and writes
+        the emergency save on its own exit path. Telemetry extras are
+        guarded: a telemetry failure must not abort the callback before
+        the diagnosis write and the guard trip — the stall response
+        outranks its own decoration. The memwatch/sight blocks are
+        host-cached only (``report()``, never ``snapshot()``): the
+        stall path must not read the wedged backend it diagnoses."""
+        cfg, res, log = self.cfg, self.res, self.log
+        extra = {}
+        if self.rec.enabled:
+            try:
+                extra["recent_spans"] = self.rec.tail()
+            except Exception:  # noqa: BLE001 — diagnostics only
+                log.exception("graftscope: flight tail unavailable")
+        if self.mw.enabled:
+            extra["memwatch"] = self.mw.report()
+        if self.sight_mon is not None:
+            extra["sight"] = self.sight_mon.report()
+        watchdog.write_diagnosis(diag, self.model_dir, extra=extra or None)
+        self.guard.request(tag)
+        if self.wake is not None:
+            self.wake()              # unblock any queue-condition wait
+        # single-process only: save_checkpoint is a lockstep collective
+        # sequence in multi-host, and a one-sided save from THIS
+        # process's stalled watchdog would hang in sync_global_devices
+        # barriers its (healthy, not-saving) peers never enter — wedging
+        # the watchdog thread while it holds save_lock. Multi-host
+        # stalls still get the diagnosis + guard trip; resume falls back
+        # to the last cadence save. A stall during the checkpoint write
+        # itself also skips the save (the staging directory is in use by
+        # the stalled writer), as does donated-and-consumed state (its
+        # buffers are gone).
+        if (save and cfg.save_model and res.emergency_checkpoint
+                and jax.process_count() == 1
+                and not diag.phase.startswith("checkpoint")
+                and diag.state is not None
+                and watchdog.state_intact(diag.state)):
+            # stall callbacks run on their own threads (the monitor
+            # keeps watching), so a previous callback wedged inside the
+            # stalled backend may still hold the lock — blocking
+            # unbounded here would just stack dead threads
+            if not self.acquire_save_lock("watchdog emergency save"):
+                return
+            try:
+                save_to = save_checkpoint(
+                    self.model_dir, diag.t_env, diag.state,
+                    gather_retries=res.dispatch_retries,
+                    gather_backoff_s=res.retry_backoff_s)
+                log.warning(f"watchdog: emergency checkpoint saved to "
+                            f"{save_to}")
+            except Exception as e:  # noqa: BLE001 — device may be wedged
+                log.warning(f"watchdog: emergency checkpoint failed "
+                            f"({e!r}); resume falls back to the last "
+                            f"cadence save")
+            finally:
+                self.save_lock.release()
 
 
 def run(cfg: TrainConfig, logger: Optional[Logger] = None) -> TrainState:
@@ -730,23 +1001,26 @@ def run_sequential(exp: Experiment, logger: Logger,
     sight_mon = obs_sight.make_monitor(cfg.obs, logger=logger, rec=rec,
                                        population=P)
 
-    def _persist_flight(path: str) -> None:
-        """Flight persist + the memwatch high-water + sight-verdict
-        blocks (cached state only — safe on crash/stall paths over a
-        wedged backend)."""
-        extra = {}
-        if mw.enabled:
-            extra["memwatch"] = mw.report()
-        if sight_mon is not None:
-            extra["sight"] = sight_mon.report()
-        rec.persist(path, extra=extra or None)
-
     # ---- data parallelism (SURVEY.md §7.2(6)) --------------------------
     # dp_devices > 0 swaps in the mesh-sharded program triple; the loop
     # below is identical either way (same pure functions, GSPMD shardings
     # come from input placement — parallel/mesh.py)
     dp = None
-    if cfg.dp_devices:
+    pop_mesh = None
+    if cfg.dp_devices and P:
+        # population-over-dp (graftlattice): the mesh shards the LEADING
+        # (P,) member axis — whole members per device, no cross-member
+        # collectives — so the episode-axis DataParallel wrapper (and
+        # its divisibility invariant) does not apply. GSPMD shardings
+        # come from input placement exactly like classic dp: the stacked
+        # state is device_put with population_shardings below and the
+        # unchanged vmapped programs propagate the member axis.
+        from .parallel import make_mesh, population_shardings
+        pop_mesh = make_mesh(cfg.dp_devices)
+        log.info(f"population-over-dp: {P} members sharded over "
+                 f"{cfg.dp_devices} devices (mesh axis 'data', "
+                 f"{P // cfg.dp_devices} members per device)")
+    elif cfg.dp_devices:
         from .parallel import DataParallel, make_mesh
         dp = DataParallel(exp, make_mesh(cfg.dp_devices))
         log.info(f"data-parallel over {cfg.dp_devices} devices "
@@ -855,6 +1129,19 @@ def run_sequential(exp: Experiment, logger: Logger,
         ts = ts.replace(runner=ts.runner.replace(t_env=new_t))
         log.info(f"resumed from {dirname} at t_env={step}")
 
+    if pop_mesh is not None:
+        # population-over-dp placement: shard every leaf (state AND
+        # spec) on the leading member axis. Fresh and resumed states
+        # both route through here — the single device_put is the whole
+        # parallelization, because the vmapped programs are rank-
+        # polymorphic over placement (GSPMD propagates the member
+        # sharding through the batched graph). Members never
+        # communicate: control state matches replication bit-exactly,
+        # floats at ULP scale (partitioning retiles batched reduces —
+        # see parallel/mesh.py population_shardings).
+        ts = jax.device_put(ts, population_shardings(pop_mesh, ts))
+        spec = jax.device_put(spec, population_shardings(pop_mesh, spec))
+
     model_dir = os.path.join(cfg.local_results_path, "models",
                              os.path.basename(results_dir))
 
@@ -883,82 +1170,21 @@ def run_sequential(exp: Experiment, logger: Logger,
     # the main thread while the watchdog's save is still mid-write
     save_lock = threading.Lock()
 
-    def _acquire_save_lock(where: str) -> bool:
-        """BOUNDED acquire shared by every save site: an emergency save
-        wedged inside the stalled backend can hold the lock forever, and
-        each waiter (watchdog callback, save cadence, exit path) must
-        skip with a warning instead of inheriting the hang — resume then
-        falls back to the newest published checkpoint."""
-        if save_lock.acquire(timeout=max(res.stall_grace_s, 60.0)):
-            return True
-        log.warning(f"{where}: checkpoint skipped — an emergency save "
-                    f"still holds the save lock (wedged backend?); "
-                    f"resume falls back to the newest published "
-                    f"checkpoint")
-        return False
-
-    def _on_stall(diag: watchdog.StallDiagnosis) -> None:
-        # the flight-recorder tail rides along in the diagnosis: the
-        # hanging span is still open, so tail() puts it LAST — the
-        # causal trail a wedged BENCH run never used to leave. Guarded:
-        # a telemetry failure here must not abort the callback before
-        # the diagnosis write and the guard trip below — the stall
-        # response outranks its own decoration
-        extra = {}
-        if rec.enabled:
-            try:
-                extra["recent_spans"] = rec.tail()
-            except Exception:  # noqa: BLE001 — diagnostics only
-                log.exception("graftscope: flight tail unavailable")
-        if mw.enabled:
-            # cached high-water only (report(), never snapshot()): the
-            # stall path must not read the wedged backend it diagnoses
-            extra["memwatch"] = mw.report()
-        if sight_mon is not None:
-            # learning-health verdicts fold into the diagnosis (host-
-            # cached like the memwatch block — a stalled run whose PER
-            # had already collapsed should say so post-mortem)
-            extra["sight"] = sight_mon.report()
-        watchdog.write_diagnosis(diag, model_dir, extra=extra or None)
-        # trip the guard BEFORE the save attempt: the emergency save
-        # below reads device state over the possibly-wedged backend and
-        # can block without raising — with stall_grace_s=0 (no hard
-        # exit) a guard tripped only afterwards would never trip at all,
-        # and the orderly "rely on the ShutdownGuard path once the call
-        # returns" fallback the config documents could never run
-        guard.request("watchdog")
-        # single-process only, same reason as the cadence-save retry
-        # below: save_checkpoint is a lockstep collective sequence in
-        # multi-host, and a one-sided save from THIS process's stalled
-        # watchdog would hang in sync_global_devices barriers its
-        # (healthy, not-saving) peers never enter — wedging the watchdog
-        # thread while it holds save_lock. Multi-host stalls still get
-        # the diagnosis + guard trip; resume falls back to the last
-        # cadence save.
-        if (cfg.save_model and res.emergency_checkpoint
-                and jax.process_count() == 1
-                and not diag.phase.startswith("checkpoint")
-                and diag.state is not None
-                and watchdog.state_intact(diag.state)):
-            # stall callbacks run on their own threads (the monitor
-            # keeps watching), so a previous callback wedged inside the
-            # stalled backend may still hold the lock — blocking
-            # unbounded here would just stack dead threads
-            if not _acquire_save_lock("watchdog emergency save"):
-                return
-            try:
-                save_to = save_checkpoint(
-                    model_dir, diag.t_env, diag.state,
-                    gather_retries=res.dispatch_retries,
-                    gather_backoff_s=res.retry_backoff_s)
-                log.warning(f"watchdog: emergency checkpoint saved to "
-                            f"{save_to}")
-            except Exception as e:  # noqa: BLE001 — device may be wedged
-                log.warning(f"watchdog: emergency checkpoint failed "
-                            f"({e!r}); resume falls back to the last "
-                            f"cadence save")
-            finally:
-                save_lock.release()
+    # graftlattice shared driver kit: the flight-persist, save-lock,
+    # stall-response, watchdog-stamp and fault-handled-dispatch bodies
+    # shared with run_sebulba (_DriverKit above) — bound to the local
+    # names every call site (and graftlint GL110's name-keyed phase
+    # check) keys on. The classic loop's shape: one armed watchdog
+    # stamps every device-facing region by default, and the loop's own
+    # t_env cursor threads into every stamp/span.
+    kit = _DriverKit(cfg=cfg, res=res, log=log, rec=rec, mw=mw,
+                     sight_mon=sight_mon, guard=guard,
+                     model_dir=model_dir, save_lock=save_lock,
+                     P=P, spec_fn=lambda: spec)
+    kit.t_env_fn = lambda: t_env
+    _persist_flight = kit.persist_flight
+    _acquire_save_lock = kit.acquire_save_lock
+    _on_stall = kit.stall_response
 
     wd = None
     if res.dispatch_timeout > 0:
@@ -971,8 +1197,9 @@ def run_sequential(exp: Experiment, logger: Logger,
                  f"phase: {res.first_dispatch_timeout or 'unbounded'}, "
                  f"compile exemption), hard-exit grace="
                  f"{res.stall_grace_s}s (exit {res.stall_exit_code})")
+    # arm the kit: bare _watched/_dispatch calls stamp this watchdog
+    kit.default_wd = wd
     ladder = watchdog.DegradationLadder(res.max_restores)
-    dispatch_faults = 0             # transient dispatch errors seen (stats)
     if pulse is not None:
         # live health/heartbeat surface: the watchdog rows are read per
         # scrape (visible while the main thread is wedged), and
@@ -989,27 +1216,10 @@ def run_sequential(exp: Experiment, logger: Logger,
             # moment the host pass trips it
             sight_mon.wire_pulse(pulse.hub)
 
-    def _watched(phase, state=None, **meta):
-        """One watchdog stamp + graftscope span for a device-facing
-        region (no-op context when both are disabled) — keeps the
-        wd-None guard, the current-t_env threading, and the telemetry
-        pairing in one place instead of at every site. ``meta`` lands
-        in the span event (attempt counts, K); the watchdog stamp is
-        the OUTER context so a hang inside the span bookkeeping is
-        still bounded."""
-        if P and state is not None and not hasattr(state, "spec"):
-            # population runs stamp the CHECKPOINTABLE PopState, never
-            # the bare stacked TrainState: the watchdog's emergency
-            # save writes the stamped state verbatim, and a bare
-            # stacked tree would hit the single-member→population
-            # migration shim on restore and double-stack
-            state = graftpop.PopState(ts=state, spec=spec)
-        w = (wd.watch(phase, t_env=t_env, state=state)
-             if wd is not None else None)
-        if rec.enabled:
-            s = rec.span(phase, t_env=t_env, **meta)
-            return obs_spans.stacked(w, s) if w is not None else s
-        return w if w is not None else nullcontext()
+    # one watchdog stamp + graftscope span per device-facing region
+    # (_DriverKit.watched: wd-None guard, t_env threading, PopState
+    # wrap, telemetry pairing — shared with run_sebulba)
+    _watched = kit.watched
 
     last_test_t = t_env - cfg.test_interval - 1
     last_log_t = t_env
@@ -1075,13 +1285,6 @@ def run_sequential(exp: Experiment, logger: Logger,
     # test, checkpoint), letting the host enqueue ahead of the device.
     steps_per_rollout = cfg.batch_size_run * cfg.env_args.episode_limit
 
-    def _host_int(x) -> int:
-        """Host mirror of a control counter. Under a population the
-        counter is (P,)-stacked but every member's copy evolves
-        identically (same batch_size_run, capacity, gates), so member
-        0's value mirrors the whole stacked pytree."""
-        return int(np.asarray(jax.device_get(x)).reshape(-1)[0])
-
     episode = _host_int(ts.episode)                    # restored on resume
     buffer_filled = (0 if exp.host_buffer else
                      _host_int(ts.buffer.episodes_in_buffer))
@@ -1089,48 +1292,11 @@ def run_sequential(exp: Experiment, logger: Logger,
     inflight = deque()              # rollout outputs not yet waited on
 
     # ---- fault-handled dispatch + ladder plumbing (RESILIENCE.md §5) ---
-    def _dispatch(phase, fn, state, retryable=True, **context):
-        """One device-facing dispatch: fault-injection hook + watchdog
-        heartbeat + bounded in-place retry with backoff (ladder rung 0).
-        Transient-classified failures retry ``fn`` with the SAME inputs —
-        the callers commit their host mirrors only after success, so a
-        retry replays an identical dispatch. Pass ``retryable=False``
-        when ``fn`` carries non-idempotent HOST side effects the
-        commit-after-success discipline cannot cover (the host-buffer
-        path: ``buffer.sample()`` advances the host RNG and the ring
-        insert mutates host RAM before a transient h2d/sync failure
-        surfaces, and ``state_intact`` can't see host mutations — a
-        retry would train on a different batch or double-insert); the
-        first transient failure then goes straight to the ladder.
-        Deterministic errors propagate immediately (retrying a shape bug
-        only delays the real diagnosis); exhausted retries — or a
-        failure that already consumed the donated state — raise
-        DispatchFailed for the ladder. Deliberately NOT composed from
-        watchdog.retry_call: the per-attempt stamp+fire, the donation
-        check, and the exhaustion→DispatchFailed conversion don't fit
-        its propagate-last-error contract."""
-        nonlocal dispatch_faults
-        attempts = (1 + res.dispatch_retries) if retryable else 1
-        for attempt in range(1, attempts + 1):
-            try:
-                with _watched(phase, state, attempt=attempt, **context):
-                    # the hook fires INSIDE the watched region: an
-                    # injected sleep here is indistinguishable from a
-                    # hung dispatch to the watchdog (tests rely on this)
-                    resilience.fire(phase, t_env=t_env, attempt=attempt,
-                                    **context)
-                    return fn()
-            except Exception as e:  # noqa: BLE001 — classified below
-                if not watchdog.is_transient(e):
-                    raise
-                dispatch_faults += 1
-                if attempt >= attempts or not watchdog.state_intact(state):
-                    raise watchdog.DispatchFailed(phase, attempt, e) from e
-                delay = watchdog.backoff_delay(attempt, res.retry_backoff_s)
-                log.warning(f"{phase}: transient dispatch failure "
-                            f"(attempt {attempt}/{attempts}), retrying "
-                            f"in {delay:.2f}s: {type(e).__name__}: {e}")
-                time.sleep(delay)
+    # one device-facing dispatch: fault-injection hook + watchdog
+    # heartbeat + bounded in-place retry (ladder rung 0) — shared body
+    # in _DriverKit.dispatch; transient-failure counts accumulate in
+    # kit.dispatch_faults for the log cadence below
+    _dispatch = kit.dispatch
 
     def _restore_checkpoint(dirname, step):
         """Reload a published checkpoint and re-sync every host-side
@@ -1145,6 +1311,16 @@ def run_sequential(exp: Experiment, logger: Logger,
             ps = load_checkpoint(dirname, _ckpt_state(), verify=False)
             ts, spec = ps.ts, ps.spec
             new_t = jnp.full((P,), step, jnp.int32)
+            if pop_mesh is not None:
+                # re-shard onto the member axis (same placement as the
+                # startup path — a single-device restore mid-run would
+                # hand the next dispatch differently-placed inputs)
+                ts = jax.device_put(ts, population_shardings(pop_mesh,
+                                                             ts))
+                spec = jax.device_put(
+                    spec, population_shardings(pop_mesh, spec))
+                new_t = jax.device_put(new_t,
+                                       ts.runner.t_env.sharding)
         elif dp is not None:
             # same born-sharded restore as the resume path: the live ts
             # only contributes shape metadata (its donated leaves may
@@ -1249,28 +1425,13 @@ def run_sequential(exp: Experiment, logger: Logger,
             + f" — last failure: {df}") from df
 
     def _sync_point(phase, fn):
-        """One blocking sync/fetch boundary (run-ahead wait, cadence stat
-        fetch): watchdog stamp + fault-injection hook + transient
-        classification in one place. On the production path
-        (``sync_stages`` off) these host round-trips are where a
-        device-side wedge or async fault actually surfaces, so each must
-        carry a stamp — an unstamped blocking fetch is exactly the
-        silent hang this layer exists to bound. No in-place retry is
-        possible here (the already-dispatched computation's donated
-        inputs are gone and its outputs are suspect), so a transient
-        failure raises ``DispatchFailed`` for the caller to route to the
-        ladder with ``can_degrade=False`` — restore is the only rung
-        that can stand; deterministic errors propagate unwrapped."""
-        nonlocal dispatch_faults
-        try:
-            with _watched(phase, ts):
-                resilience.fire(phase, t_env=t_env)
-                return fn()
-        except Exception as e:  # noqa: BLE001 — classified below
-            if not watchdog.is_transient(e):
-                raise
-            dispatch_faults += 1
-            raise watchdog.DispatchFailed(phase, 1, e) from e
+        """One blocking sync/fetch boundary (run-ahead wait, cadence
+        stat fetch) — shared body in ``_DriverKit.sync_point``. Stays a
+        local def (not a bare bound method) so the stamp always carries
+        the loop's CURRENT ``ts``: the state local is rebound across
+        restores and donated dispatches, and an early capture would
+        stamp deleted buffers."""
+        return kit.sync_point(phase, fn, ts)
 
     # signal handlers are process-global state: restore them on
     # EVERY exit (normal, preemption, divergence abort)
@@ -1342,9 +1503,19 @@ def run_sequential(exp: Experiment, logger: Logger,
                     if P:
                         # (P, K, 2) — the vmapped program maps axis 0,
                         # each member scanning its own (K,) key rows
+                        keys = jnp.stack(key_rows, axis=1)
+                        if pop_mesh is not None:
+                            # member-axis placement for the key stack
+                            # too: the dispatched program must see the
+                            # same input shardings as the audited
+                            # pop_dp_superstep twin (a replicated key
+                            # input would lower a different SPMD
+                            # program than the one ratcheted)
+                            keys = jax.device_put(
+                                keys, population_shardings(pop_mesh,
+                                                           keys))
                         ts2, stats, infos = superstep(
-                            ts, jnp.stack(key_rows, axis=1),
-                            jnp.asarray(t_env), spec)
+                            ts, keys, jnp.asarray(t_env), spec)
                     else:
                         ts2, stats, infos = superstep(
                             ts, jnp.stack(key_rows), jnp.asarray(t_env))
@@ -1505,6 +1676,15 @@ def run_sequential(exp: Experiment, logger: Logger,
                                 return rs, s
                             rs, s = _dispatch("dispatch.test", _test_roll,
                                               ts)
+                            if pop_mesh is not None:
+                                # pin the runner back to the member-axis
+                                # placement (no-op when GSPMD already
+                                # propagated it): the next superstep's
+                                # input shardings must not drift with
+                                # XLA's output-sharding choices
+                                rs = jax.device_put(
+                                    rs, population_shardings(pop_mesh,
+                                                             rs))
                             ts = ts.replace(runner=rs)
                             # the push's periodic device fold is a
                             # blocking fetch like the train-side one —
@@ -1738,12 +1918,12 @@ def run_sequential(exp: Experiment, logger: Logger,
                         restores += 1
                         nonfinite_streak = 0
                         continue
-                if dispatch_faults:
+                if kit.dispatch_faults:
                     # ladder visibility: cumulative transient dispatch
                     # errors (in-place retries included); per-escalation
                     # counters land in _dispatch_ladder as they happen
-                    logger.log_stat("dispatch_faults", dispatch_faults,
-                                    t_env)
+                    logger.log_stat("dispatch_faults",
+                                    kit.dispatch_faults, t_env)
                 if rec.enabled:
                     # device-fetch accounting (utils/stats.py): how many
                     # blocking stat round-trips the cadences have cost
@@ -1769,7 +1949,7 @@ def run_sequential(exp: Experiment, logger: Logger,
                 if pulse is not None:
                     pulse.set("nonfinite_streak", nonfinite_streak)
                     pulse.set("nonfinite_total", nonfinite_total)
-                    pulse.set("dispatch_faults", dispatch_faults)
+                    pulse.set("dispatch_faults", kit.dispatch_faults)
                     pulse.set("ladder_failures", ladder.failures)
                     pulse.set("restores", restores)
                     pulse.set("superstep_k", K)
@@ -1917,6 +2097,18 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
     log = logger.console_logger
     if rec is None:
         rec = obs_spans.make_recorder(cfg.obs, results_dir)
+
+    # ---- graftpop population axis over the decoupled loop ---------------
+    # (graftlattice, docs/POPULATION.md §composition): P > 0 stacks a
+    # leading (P,) member axis onto BOTH halves of the split state and
+    # vmaps every sebulba program over it (parallel/sebulba.py). Only
+    # lockstep queues are legal (sanity_check): the queue serializes
+    # rollout→insert→train exactly like the classic population loop, so
+    # the host loop below needs no per-member control flow — counters,
+    # gates and cadences mirror member 0 (every member's control
+    # counters evolve identically; _host_int).
+    from . import population as graftpop
+    P = graftpop.population_size(cfg)
     # graftpulse plane (same off-state contract as the classic loop);
     # the decoupled layout is the one Podracer says lives or dies on
     # utilization you can see live — queue depth, staleness, idle time
@@ -1935,22 +2127,22 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
 
     # graftsight monitor (learner-thread cadence pass; same off-state
     # contract as the classic loop)
-    sight_mon = obs_sight.make_monitor(cfg.obs, logger=logger, rec=rec)
+    sight_mon = obs_sight.make_monitor(cfg.obs, logger=logger, rec=rec,
+                                       population=P)
 
-    def _persist_flight(path: str) -> None:
-        extra = {}
-        if mw.enabled:
-            extra["memwatch"] = mw.report()
-        if sight_mon is not None:
-            extra["sight"] = sight_mon.report()
-        rec.persist(path, extra=extra or None)
     from .parallel.sebulba import make_sebulba
     seb = make_sebulba(exp)
+    spec = seb.spec
     lockstep = sb.queue_slots == 1 and sb.staleness == 0
     log.info(f"sebulba decoupled loop: {sb.actor_devices} actor + "
              f"{sb.learner_devices} learner devices, queue_slots="
              f"{sb.queue_slots}, staleness={sb.staleness}"
              + (" (lockstep)" if lockstep else ""))
+    if P:
+        log.info(f"graftpop × sebulba: population of {P} members vmapped "
+                 f"over the decoupled programs (seeds "
+                 f"{graftpop.member_seeds(cfg)}, member axis sharded "
+                 f"over each device set)")
 
     res = cfg.resilience
     guard = (resilience.ShutdownGuard.install() if res.handle_signals
@@ -1960,7 +2152,7 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
     save_lock = threading.Lock()
     spr = cfg.batch_size_run * cfg.env_args.episode_limit
     n_test_runs = max(1, cfg.test_nepisode // cfg.batch_size_run)
-    test_quota = n_test_runs * cfg.batch_size_run
+    test_quota = n_test_runs * cfg.batch_size_run * max(P, 1)
     buffer_capacity = exp.buffer.capacity
 
     actor_step, queue_put, queue_get, learner_step = seb.programs()
@@ -1976,10 +2168,26 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
     idle = {"actor_s": 0.0, "learner_s": 0.0}   # cumulative blocked time
     stop_event = threading.Event()   # epoch teardown (restore/exit)
     actor_failure = []               # DispatchFailed escaped from the actor
-    dispatch_faults = 0
     nonfinite_streak = 0
     nonfinite_total = 0
     restores = 0
+
+    # ---- shared driver-helper kit (graftlattice) ----------------------
+    # default_wd stays None: each thread passes awd= explicitly (one
+    # armed stamp per watchdog instance), and the queue waits bounded by
+    # the PEER's progress stay span-only; wake= lets the stall response
+    # unblock either thread's queue-condition wait.
+    def _wake():
+        with cond:
+            cond.notify_all()
+    kit = _DriverKit(cfg=cfg, res=res, log=log, rec=rec, mw=mw,
+                     sight_mon=sight_mon, guard=guard,
+                     model_dir=model_dir, save_lock=save_lock,
+                     P=P, spec_fn=lambda: spec, wake=_wake)
+    _persist_flight = kit.persist_flight
+    _acquire_save_lock = kit.acquire_save_lock
+    _watched = kit.watched
+    _dispatch = kit.dispatch
 
     # ---- resume target ------------------------------------------------
     found = None
@@ -1987,16 +2195,6 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
         found = find_checkpoint(cfg.checkpoint_path, cfg.load_step)
         if found is None:
             log.info(f"no checkpoint found in {cfg.checkpoint_path}")
-
-    def _acquire_save_lock(where: str) -> bool:
-        """Bounded save-lock acquire (same contract as the classic
-        loop's): a wedged emergency save must not hang every later save
-        site."""
-        if save_lock.acquire(timeout=max(res.stall_grace_s, 60.0)):
-            return True
-        log.warning(f"{where}: checkpoint skipped — an emergency save "
-                    f"still holds the save lock (wedged backend?)")
-        return False
 
     def _snapshot_state():
         """The latest complete joined TrainState (for stamps and
@@ -2008,63 +2206,15 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
 
     state_cell = {"ls": None}        # learner-side handle (main thread owns)
 
-    def _on_stall(diag: watchdog.StallDiagnosis) -> None:
-        """Learner-side stall response (same shape as the classic
-        loop's): diagnosis + flight tail, guard trip, then a bounded
-        emergency checkpoint from the stamped pre-dispatch state."""
-        extra = {}
-        if rec.enabled:
-            try:
-                extra["recent_spans"] = rec.tail()
-            except Exception:  # noqa: BLE001 — diagnostics only
-                log.exception("graftscope: flight tail unavailable")
-        if mw.enabled:
-            extra["memwatch"] = mw.report()     # cached, no device reads
-        if sight_mon is not None:
-            extra["sight"] = sight_mon.report()
-        watchdog.write_diagnosis(diag, model_dir, extra=extra or None)
-        guard.request("watchdog")
-        with cond:
-            cond.notify_all()        # wake any blocked queue wait
-        if (cfg.save_model and res.emergency_checkpoint
-                and jax.process_count() == 1
-                and not diag.phase.startswith("checkpoint")
-                and diag.state is not None
-                and watchdog.state_intact(diag.state)):
-            if not _acquire_save_lock("watchdog emergency save"):
-                return
-            try:
-                save_to = save_checkpoint(
-                    model_dir, diag.t_env, diag.state,
-                    gather_retries=res.dispatch_retries,
-                    gather_backoff_s=res.retry_backoff_s)
-                log.warning(f"watchdog: emergency checkpoint saved to "
-                            f"{save_to}")
-            except Exception as e:  # noqa: BLE001 — device may be wedged
-                log.warning(f"watchdog: emergency checkpoint failed "
-                            f"({e!r}); resume falls back to the last "
-                            f"cadence save")
-            finally:
-                save_lock.release()
+    # learner-side stall: full kit response (diagnosis + guard trip +
+    # bounded emergency save from the stamped pre-dispatch state);
+    # actor-side: diagnosis + guard trip only — the learner (main)
+    # thread owns the checkpointable state and writes the emergency
+    # save on its own exit path
+    _on_stall = kit.stall_response
 
     def _on_actor_stall(diag: watchdog.StallDiagnosis) -> None:
-        """Actor-side stall response: diagnosis + guard trip only — the
-        learner (main) thread owns the checkpointable state and will
-        write the emergency save on its own exit path."""
-        extra = {}
-        if rec.enabled:
-            try:
-                extra["recent_spans"] = rec.tail()
-            except Exception:  # noqa: BLE001 — diagnostics only
-                pass
-        if mw.enabled:
-            extra["memwatch"] = mw.report()
-        if sight_mon is not None:
-            extra["sight"] = sight_mon.report()
-        watchdog.write_diagnosis(diag, model_dir, extra=extra or None)
-        guard.request("watchdog-actor")
-        with cond:
-            cond.notify_all()
+        kit.stall_response(diag, tag="watchdog-actor", save=False)
 
     wd = wd_actor = None
     if res.dispatch_timeout > 0:
@@ -2091,48 +2241,9 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
         if sight_mon is not None:
             sight_mon.wire_pulse(pulse.hub)
 
-    # ---- watched-dispatch helpers (both threads) ----------------------
-    def _watched(phase, state=None, awd=None, t=0, **meta):
-        """Watchdog stamp + span for one device-facing region; ``awd``
-        selects the calling thread's watchdog instance (one armed stamp
-        per instance — concurrent threads must not share one)."""
-        w = (awd.watch(phase, t_env=t, state=state)
-             if awd is not None else None)
-        if rec.enabled:
-            s = rec.span(phase, t_env=t, **meta)
-            return obs_spans.stacked(w, s) if w is not None else s
-        return w if w is not None else nullcontext()
-
-    def _dispatch(phase, fn, state, awd=None, t=0, retryable=True,
-                  **context):
-        """Fault-handled dispatch (the classic loop's ``_dispatch``
-        contract): hook + stamp + bounded in-place retry for transient
-        failures; exhaustion (or consumed donated state) raises
-        DispatchFailed for the ladder."""
-        nonlocal dispatch_faults
-        attempts = (1 + res.dispatch_retries) if retryable else 1
-        for attempt in range(1, attempts + 1):
-            try:
-                with _watched(phase, state, awd=awd, t=t, attempt=attempt,
-                              **context):
-                    resilience.fire(phase, t_env=t, attempt=attempt,
-                                    **context)
-                    return fn()
-            except Exception as e:  # noqa: BLE001 — classified below
-                if not watchdog.is_transient(e):
-                    raise
-                dispatch_faults += 1
-                if attempt >= attempts or not watchdog.state_intact(state):
-                    raise watchdog.DispatchFailed(phase, attempt, e) from e
-                delay = watchdog.backoff_delay(attempt, res.retry_backoff_s)
-                log.warning(f"{phase}: transient dispatch failure "
-                            f"(attempt {attempt}/{attempts}), retrying "
-                            f"in {delay:.2f}s: {type(e).__name__}: {e}")
-                time.sleep(delay)
-
     # ---- stat accumulators (actor pushes, both flush at cadences) -----
-    train_acc = StatsAccumulator()
-    test_acc = StatsAccumulator()
+    train_acc = StatsAccumulator(population=P)
+    test_acc = StatsAccumulator(population=P)
 
     def _stopping() -> bool:
         return stop_event.is_set() or guard.triggered
@@ -2273,8 +2384,18 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                 cond.notify_all()    # wake a learner waiting on the queue
 
     # ---- state init / resume ------------------------------------------
-    key = jax.random.PRNGKey(cfg.seed + 1)
+    # per-member driver key streams under a population (each member's
+    # stream splits exactly like the classic loop's single one)
+    key = graftpop.member_keys(cfg) if P else jax.random.PRNGKey(
+        cfg.seed + 1)
     t_env = 0
+
+    def _ckpt_state(ts_):
+        """What checkpoints hold: the bare TrainState classically, the
+        (state, spec) PopState under a population — the classic loop's
+        checkpoint contract, so either driver resumes the other's
+        saves."""
+        return graftpop.PopState(ts=ts_, spec=spec) if P else ts_
 
     def _place(found_):
         """(rs, ls, t_env) freshly initialized or restored. The restore
@@ -2288,6 +2409,25 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
         if found_ is None:
             return (*seb.init_states(cfg.seed), 0)
         dirname, step = found_
+        if P:
+            # population resume: the checkpoint is a PopState (or a v4
+            # single-member state the migration shim lifts to the
+            # stacked template — utils/checkpoint._migrate_raw).
+            # Abstract ts template only (P concrete inits would
+            # materialize P replay rings just to be discarded). The
+            # restored spec is ignored in favor of the program-baked
+            # one: pbt × sebulba is rejected (sanity_check), so the
+            # spec is config-determined and the two are identical.
+            shapes = jax.eval_shape(
+                lambda: graftpop.init_population(exp, cfg))[0]
+            ps = load_checkpoint(dirname, _ckpt_state(shapes),
+                                 verify=False)
+            rs, ls = seb.place(ps.ts)
+            rs = rs.replace(t_env=jax.device_put(
+                jnp.full((P,), step, jnp.int32), rs.t_env.sharding))
+            log.info(f"resumed population from {dirname} at "
+                     f"t_env={step}")
+            return rs, ls, step
         shapes = jax.eval_shape(lambda: exp.init_train_state(cfg.seed))
         rs_shape, ls_shape = seb.split_shapes(shapes)
         ts = load_checkpoint_sharded(
@@ -2309,7 +2449,7 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                  batch_size_run=cfg.batch_size_run,
                  episode_limit=cfg.env_args.episode_limit,
                  batch_size=cfg.batch_size, superstep=1,
-                 host_buffer=False, sebulba=True,
+                 host_buffer=False, sebulba=True, population=P,
                  actor_devices=sb.actor_devices,
                  learner_devices=sb.learner_devices,
                  queue_slots=sb.queue_slots, staleness=sb.staleness)
@@ -2319,9 +2459,8 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
     start_time = time.time()
     last_log_time = None
     train_infos = []
-    episode = int(jax.device_get(ls.episode))  # graftlint: disable=GL105
-    buffer_filled = int(jax.device_get(       # graftlint: disable=GL105
-        ls.buffer.episodes_in_buffer))
+    episode = _host_int(ls.episode)
+    buffer_filled = _host_int(ls.buffer.episodes_in_buffer)
     state_cell["ls"] = ls
 
     def _epoch(rs, t_env0):
@@ -2330,7 +2469,7 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
         Returns ``'done' | 'failed'`` — 'failed' hands the recorded
         DispatchFailed to the caller's ladder."""
         nonlocal ls, t_env, episode, buffer_filled, key, train_infos
-        nonlocal nonfinite_streak, nonfinite_total, dispatch_faults
+        nonlocal nonfinite_streak, nonfinite_total
         nonlocal last_log_t, last_save_t, last_log_time
         stop_event.clear()
         actor_failure.clear()
@@ -2394,7 +2533,18 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                 # train gate: the classic loop's host mirror + key split
                 if (buffer_filled >= cfg.batch_size
                         and episode >= cfg.accumulated_episodes):
-                    key2, k_sample = jax.random.split(key)
+                    if P:
+                        # per-member key streams: each member's stream
+                        # splits exactly like the classic loop's single
+                        # one (lockstep bit-parity with the classic
+                        # population loop depends on it)
+                        key2, rows = list(key), []
+                        for m in range(P):
+                            key2[m], k_s = jax.random.split(key2[m])
+                            rows.append(k_s)
+                        k_sample = jnp.stack(rows)
+                    else:
+                        key2, k_sample = jax.random.split(key)
 
                     def _train_once(ls=ls, k_sample=k_sample):
                         ls2, info = learner_step(ls, k_sample,
@@ -2451,7 +2601,8 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                         return None
                     try:
                         return save_checkpoint(
-                            model_dir, t_env, _snapshot_state(),
+                            model_dir, t_env,
+                            _ckpt_state(_snapshot_state()),
                             gather_retries=res.dispatch_retries,
                             gather_backoff_s=res.retry_backoff_s)
                     finally:
@@ -2475,6 +2626,14 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                 flags, last = _dispatch("fetch.train_infos",
                                         _fetch_infos, None, awd=wd,
                                         t=t_env, retryable=False)
+                if P:
+                    # (n, P) member flags: a train step counts as
+                    # finite only when EVERY member's update was —
+                    # one poisoned member is a restore-worthy event
+                    # exactly like a solo NaN (the stacked state is
+                    # one checkpoint)
+                    flags = flags.reshape(len(train_infos), -1)\
+                                 .all(axis=1)
                 for ok in flags:
                     if ok:
                         nonfinite_streak = 0
@@ -2493,7 +2652,19 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                         f"since last log (streak={nonfinite_streak})")
                 for k in ("loss", "grad_norm", "td_error_abs",
                           "q_taken_mean", "target_mean"):
-                    logger.log_stat(k, float(last[k]), t_env)
+                    if P:
+                        # aggregate row = population mean; per-member
+                        # rows (pop<i>_*) only at P > 1 so a P=1 run
+                        # keeps the solo metric stream (the classic
+                        # population cadence's shape)
+                        v = np.asarray(last[k], np.float64)
+                        logger.log_stat(k, float(v.mean()), t_env)
+                        if P > 1:
+                            for m in range(P):
+                                logger.log_stat(f"pop{m}_{k}",
+                                                float(v[m]), t_env)
+                    else:
+                        logger.log_stat(k, float(last[k]), t_env)
                 if sight_mon is not None:
                     # classic-loop contract: detector pass on the same
                     # fetch, flight persist on a fresh trip
@@ -2522,8 +2693,9 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                 rec.mark("sebulba", t_env=t_env, queue_depth=depth,
                          actor_idle_s=round(idle["actor_s"], 3),
                          learner_idle_s=round(idle["learner_s"], 3))
-            if dispatch_faults:
-                logger.log_stat("dispatch_faults", dispatch_faults, t_env)
+            if kit.dispatch_faults:
+                logger.log_stat("dispatch_faults", kit.dispatch_faults,
+                                t_env)
             logger.log_stat("episode", episode, t_env)
             now = time.time()
             rate = None
@@ -2544,7 +2716,7 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                 pulse.set("learner_idle_seconds",
                           round(idle["learner_s"], 3))
                 pulse.set("nonfinite_streak", nonfinite_streak)
-                pulse.set("dispatch_faults", dispatch_faults)
+                pulse.set("dispatch_faults", kit.dispatch_faults)
                 pulse.set("ladder_failures", ladder.failures)
                 pulse.set("restores", restores)
                 pulse.set_memwatch(pulse_snap)
@@ -2574,20 +2746,19 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                             f"({ladder.describe()})")
                 rs0, ls, t_env = _place(good)
                 state_cell["ls"] = ls
-                episode = int(jax.device_get(ls.episode))  # graftlint: disable=GL105
-                buffer_filled = int(jax.device_get(       # graftlint: disable=GL105
-                    ls.buffer.episodes_in_buffer))
+                episode = _host_int(ls.episode)
+                buffer_filled = _host_int(ls.buffer.episodes_in_buffer)
                 train_infos = []
                 nonfinite_streak = 0
                 fetches = train_acc.fetches
-                train_acc = StatsAccumulator()
+                train_acc = StatsAccumulator(population=P)
                 train_acc.fetches = fetches
                 # the torn-down actor thread may have died mid-test-
                 # cadence: a partial accumulation would miss the
                 # exact-quota flush on every later cadence (the classic
                 # loop's test-failure reset, same reasoning)
                 tfetches = test_acc.fetches
-                test_acc = StatsAccumulator()
+                test_acc = StatsAccumulator(population=P)
                 test_acc.fetches = tfetches
                 restores += 1
                 last_log_t = last_save_t = t_env
@@ -2643,7 +2814,7 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                     with deadline:
                         save_to = watchdog.retry_call(
                             lambda: save_checkpoint(
-                                model_dir, t_env, ts,
+                                model_dir, t_env, _ckpt_state(ts),
                                 gather_retries=res.dispatch_retries,
                                 gather_backoff_s=res.retry_backoff_s),
                             attempts=1 + res.dispatch_retries,

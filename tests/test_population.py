@@ -165,13 +165,12 @@ def test_population_bare_int_shorthand_and_grids():
 
 
 def test_sanity_rejects_incompatible_combos():
-    with pytest.raises(ValueError, match="buffer_cpu_only"):
+    # every REMAINING rejection names the blocking mechanism AND the
+    # nearest legal alternative (graftlattice satellite contract)
+    with pytest.raises(ValueError, match="vmaps the device-resident"):
         pop_cfg(2, replay_kw={"buffer_cpu_only": True})
-    with pytest.raises(ValueError, match="dp_devices"):
-        pop_cfg(2, dp_devices=2)
-    with pytest.raises(ValueError, match="pallas"):
-        from t2omca_tpu.config import KernelsConfig
-        pop_cfg(2, kernels=KernelsConfig(attention="pallas"))
+    with pytest.raises(ValueError, match="separate solo runs"):
+        pop_cfg(2, replay_kw={"buffer_cpu_only": True})
     with pytest.raises(ValueError, match="evaluate"):
         pop_cfg(2, evaluate=True)
     with pytest.raises(ValueError, match="exactly P entries"):
@@ -192,6 +191,46 @@ def test_sanity_rejects_incompatible_combos():
                 save_model=False)
     # P=0 composes with everything (the off state)
     assert tiny_cfg(dp_devices=0).population.size == 0
+
+
+def test_sanity_lattice_legal_and_gated_combos():
+    """graftlattice composition surface: population x pallas and
+    population x dp are LEGAL now; what remains rejected is the
+    divisibility/lockstep/pbt boundary, each naming the mechanism and
+    the nearest legal alternative."""
+    from t2omca_tpu.config import KernelsConfig, SebulbaConfig
+    # population x pallas: vmap-over-pallas — plain legal
+    cfg = pop_cfg(2, kernels=KernelsConfig(attention="pallas"))
+    assert cfg.population.size == 2 and cfg.kernels.attention == "pallas"
+    # population x dp: member axis shards over the mesh when divisible
+    cfg = pop_cfg(2, dp_devices=2)
+    assert cfg.population.size == 2 and cfg.dp_devices == 2
+    with pytest.raises(ValueError, match="not divisible by dp_devices"):
+        pop_cfg(3, dp_devices=2)
+    with pytest.raises(ValueError, match="divisible P or drop dp_devices"):
+        pop_cfg(3, dp_devices=2)
+    # population x sebulba: lockstep only (queue_slots=1, staleness=0)
+    sb = dict(actor_devices=1, learner_devices=1)
+    cfg = pop_cfg(2, sebulba=SebulbaConfig(queue_slots=1, staleness=0,
+                                           **sb))
+    assert cfg.population.size == 2
+    with pytest.raises(ValueError, match="LOCKSTEP"):
+        pop_cfg(2, sebulba=SebulbaConfig(queue_slots=2, staleness=0,
+                                         **sb))
+    with pytest.raises(ValueError, match="staleness=0"):
+        pop_cfg(2, sebulba=SebulbaConfig(queue_slots=1, staleness=1,
+                                         **sb))
+    # pbt x sebulba: save-boundary exploit/explore can't reach the
+    # decoupled actor thread mid-epoch
+    with pytest.raises(ValueError, match="checkpoint-save boundary"):
+        pop_cfg(2, pop_kw={"pbt": PBTConfig(enabled=True)},
+                save_model=True,
+                sebulba=SebulbaConfig(queue_slots=1, staleness=0, **sb))
+    # member axis must tile each sebulba device set
+    with pytest.raises(ValueError, match="divisible by sebulba"):
+        pop_cfg(3, sebulba=SebulbaConfig(queue_slots=1, staleness=0,
+                                         actor_devices=2,
+                                         learner_devices=1))
 
 
 def test_build_spec_neutral_and_gridded():

@@ -710,10 +710,12 @@ def test_daemon_legs_matrix():
         artifact = None
         legs = None
     legs = dict(bench._daemon_legs(A()))
-    assert set(legs) == {"superstep", "kernels", "sebulba", "population"}
+    assert set(legs) == {"superstep", "kernels", "sebulba", "population",
+                         "lattice"}
     assert "--smoke" in legs["superstep"]
     assert legs["kernels"][:2] == ["--kernels", "ab"]
     assert legs["population"][:2] == ["--population", "4"]
+    assert legs["lattice"][0] == "--lattice"
     A.artifact = "/art"
     assert "serve" in dict(bench._daemon_legs(A()))
     A.legs = "superstep,sebulba"
