@@ -6,6 +6,12 @@
 # no jax import, no backend startup — so it runs in front of the tier-1
 # pytest batch (scripts/t1.sh) at negligible cost.
 #
+# The same CLI also hosts the two compiled audit levels — `--programs`
+# (graftprog: per-program HLO budgets/fingerprints, GP2xx/GP3xx) and
+# `--comms` (graftshard: collective census + sharding rules, GP4xx) —
+# which DO start a backend; t1.sh runs them as separate budgeted
+# preludes rather than here.
+#
 # NB for callers: shell options do not propagate upward, so nothing in
 # THIS script can protect `bash scripts/lint.sh | tee log` — the caller
 # must own its pipe status (t1.sh uses `set -o pipefail` +

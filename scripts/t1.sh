@@ -39,4 +39,11 @@ grep -q "TRIPPED" /tmp/_t1_sight.log || { echo "obs learning smoke: seeded detec
 # gate no-op
 timeout -k 10 240 env JAX_PLATFORMS=cpu python -m t2omca_tpu.analysis --programs 2>&1 | tee /tmp/_t1_prog.log; prc=${PIPESTATUS[0]}
 [ $prc -ne 0 ] && { [ $prc -eq 124 ] && echo "graftprog gate timed out (240s budget; docs/ANALYSIS.md)" || echo "graftprog gate failed (exit $prc; docs/ANALYSIS.md)"; exit 1; }
+# Prelude 3 (graftshard, ~60 s budgeted at 180 s): compile the
+# mesh-placed programs under the fixed audit meshes and ratchet their
+# collective census + sharding rules (GP4xx) + the params.sync transfer
+# table against the same programs.json. Same contract: a wedged comms
+# audit is a gate failure (timeout exit 124), never a silent skip.
+timeout -k 10 180 env JAX_PLATFORMS=cpu python -m t2omca_tpu.analysis --comms 2>&1 | tee /tmp/_t1_comms.log; crc=${PIPESTATUS[0]}
+[ $crc -ne 0 ] && { [ $crc -eq 124 ] && echo "graftshard gate timed out (180s budget; docs/ANALYSIS.md)" || echo "graftshard gate failed (exit $crc; docs/ANALYSIS.md)"; exit 1; }
 rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,11 +1,13 @@
-"""``python -m t2omca_tpu.analysis`` — the graftlint/graftprog CLI.
+"""``python -m t2omca_tpu.analysis`` — the graftlint/graftprog/
+graftshard CLI.
 
 Exit codes (the contract ``scripts/lint.sh``, ``scripts/t1.sh`` and the
 tier-1 gate rely on): 0 = no new findings (baselined accepted findings
 are fine), 1 = new findings (lint: ``path:line:col: RULE message``;
-``--programs``: ``program: RULE message``), 2 = usage/internal error.
-Stale baseline entries are warned about but never fail — re-run with
-``--write-baseline`` / ``--write-programs`` to tighten the ratchet.
+``--programs``/``--comms``: ``program: RULE message``), 2 =
+usage/internal error. Stale baseline entries are warned about but never
+fail — re-run with ``--write-baseline`` / ``--write-programs`` to
+tighten the ratchet.
 
 The default (lint) path is deliberately jax-free: pure AST, runs in
 front of every test batch, must not pay backend startup. ``--programs``
@@ -13,7 +15,11 @@ is the opposite: it lowers (and for the donated hot programs compiles)
 the registered XLA programs on a tiny CPU config — it forces
 ``JAX_PLATFORMS=cpu`` and a 4-CPU-device host platform so the audited
 programs (and their checked-in fingerprints, ``analysis/programs.json``)
-are identical on every machine, TPU hosts included.
+are identical on every machine, TPU hosts included. ``--comms`` is the
+third level (graftshard, docs/ANALYSIS.md): it compiles the MESH-placed
+registry programs under their fixed audit meshes and ratchets the
+collective census + sharding rules (GP4xx) plus the registered
+cross-mesh transfers against the same baseline file.
 """
 
 from __future__ import annotations
@@ -26,7 +32,7 @@ from pathlib import Path
 
 from .baseline import (DEFAULT_BASELINE, DEFAULT_PROGRAMS, diff_baseline,
                        load_baseline, load_programs, save_baseline,
-                       save_programs)
+                       save_comms, save_programs)
 from .graftlint import RULES, lint_package
 
 
@@ -46,6 +52,145 @@ def _pin_cpu_platform() -> None:
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=4").strip()
+
+
+def _refuse_small_host(jax, registry, tool: str) -> int:
+    """Baseline writes need every fixed audit mesh buildable: on a
+    host exposing fewer devices than the largest registered mesh the
+    4-device entries (pop_dp, sebulba, dp×mp) would register as skips
+    and a rewrite would silently carry stale sections for them forever
+    (the ``--only`` refusal's silent-shrink bug class, PR 5). 0 = ok."""
+    need = registry.required_audit_devices()
+    have = len(jax.devices())
+    if have < need:
+        print(f"{tool}: error: baseline writes need the full fixed "
+              f"audit meshes: {need} host devices, have {have} (hint: "
+              f"XLA_FLAGS=--xla_force_host_platform_device_count="
+              f"{need}; unset any conflicting XLA_FLAGS)",
+              file=sys.stderr)
+        return 2
+    return 0
+
+
+def _comms_main(args) -> int:
+    """The ``--comms`` audit level: collective census + sharding rules
+    (GP4xx) of the mesh-placed registry programs and the registered
+    cross-mesh transfers — graftshard (docs/ANALYSIS.md)."""
+    if args.write_programs and args.only:
+        print("graftshard: error: --write-programs re-baselines the "
+              "FULL comms set; it cannot be combined with --only",
+              file=sys.stderr)
+        return 2
+    _pin_cpu_platform()
+    try:
+        from . import graftshard, registry
+        reg = registry.collect_default_programs()
+        for extra in args.program_module:
+            for name, prog in registry.load_programs_from(extra).items():
+                reg[name] = prog
+        reg = {n: p for n, p in reg.items()
+               if graftshard.is_mesh_program(p)}
+        transfers = registry.collect_transfer_audits()
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"graftshard: error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.only:
+        unknown = [n for n in args.only
+                   if n not in reg and n not in transfers]
+        if unknown:
+            print(f"graftshard: error: unknown mesh program(s) "
+                  f"{', '.join(sorted(unknown))} (known: "
+                  f"{', '.join(sorted(list(reg) + list(transfers)))})",
+                  file=sys.stderr)
+            return 2
+        reg = {n: p for n, p in reg.items() if n in args.only}
+        transfers = {n: t for n, t in transfers.items()
+                     if n in args.only}
+    if args.list_programs:
+        for name, prog in reg.items():
+            what = (f"SKIP ({prog.skip})" if prog.skip is not None else
+                    prog.description)
+            print(f"{name:16s} {'compile':8s} {what}")
+        for name, ta in transfers.items():
+            what = (f"SKIP ({ta.skip})" if ta.skip is not None else
+                    ta.description)
+            print(f"{name:16s} {'transfer':8s} {what}")
+        return 0
+
+    # resolve the old baseline BEFORE the compile-heavy audit — the
+    # _programs_main fast-exit-2 rationale
+    old = None
+    if args.write_programs:
+        try:
+            old = load_programs(args.programs_baseline)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f"graftshard: error: unreadable baseline "
+                  f"{args.programs_baseline}: {e}", file=sys.stderr)
+            return 2
+
+    import jax
+    if args.write_programs and (rc := _refuse_small_host(
+            jax, registry, "graftshard")):
+        return rc
+    try:
+        reports = graftshard.audit_comms_registry(reg)
+        treports = [graftshard.audit_transfer(n, t)
+                    for n, t in transfers.items()]
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"graftshard: error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.write_programs:
+        save_comms(args.programs_baseline, reports, treports,
+                   platform=jax.default_backend(), old=old or {})
+        n = sum(r.skipped is None for r in reports)
+        nt = sum(r.skipped is None for r in treports)
+        print(f"graftshard: wrote {n} comms section(s) + {nt} "
+              f"transfer entr{'y' if nt == 1 else 'ies'} to "
+              f"{args.programs_baseline}")
+        return 0
+
+    if args.no_baseline:
+        # raw audit: only the structural rules mean anything without a
+        # baseline (GP401/402 are ratchets, like GP300-302)
+        findings = graftshard.raw_findings(reports, treports)
+        stale = [f"{r.name}: skipped ({r.skipped})"
+                 for r in list(reports) + list(treports)
+                 if r.skipped is not None]
+    else:
+        try:
+            base = load_programs(args.programs_baseline)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f"graftshard: error: unreadable baseline "
+                  f"{args.programs_baseline}: {e}", file=sys.stderr)
+            return 2
+        platform = jax.default_backend()
+        if base["platform"] and base["platform"] != platform:
+            print(f"graftshard: warning: baseline is for platform "
+                  f"{base['platform']!r}, running on {platform!r} — "
+                  f"the comms census is not comparable, skipping the "
+                  f"ratchet (pin JAX_PLATFORMS=cpu)", file=sys.stderr)
+            return 0
+        findings, stale = graftshard.compare_comms(reports, treports,
+                                                   base)
+    for f in findings:
+        print(f.format())
+    for note in stale:
+        print(f"graftshard: warning: stale/skip: {note}",
+              file=sys.stderr)
+    per_rule = Counter(f.rule for f in findings)
+    summary = ", ".join(f"{r}x{c}" if c > 1 else r
+                        for r, c in sorted(per_rule.items()))
+    n_skip = sum(r.skipped is not None
+                 for r in list(reports) + list(treports))
+    print(f"graftshard: {len(reports)} mesh programs + {len(treports)} "
+          f"transfer(s) audited"
+          + (f" ({n_skip} skipped)" if n_skip else "")
+          + f", {len(findings)} new finding(s)"
+          + (f": {summary}" if summary else ""))
+    return 1 if findings else 0
 
 
 def _programs_main(args) -> int:
@@ -88,6 +233,9 @@ def _programs_main(args) -> int:
             return 2
 
     import jax
+    if args.write_programs and (rc := _refuse_small_host(
+            jax, registry, "graftprog")):
+        return rc
     compute_dtype = registry.audit_context().compute_dtype
     try:
         reports = graftprog.audit_registry(
@@ -176,6 +324,12 @@ def main(argv=None) -> int:
         help="audit the registered compiled programs (GP rules + HLO "
              "budgets) instead of linting source")
     prog_group.add_argument(
+        "--comms", action="store_true",
+        help="audit the communication structure of the mesh-placed "
+             "programs: collective census + GP4xx sharding rules "
+             "(graftshard; reuses --programs-baseline, "
+             "--write-programs, --program-module, --only)")
+    prog_group.add_argument(
         "--programs-baseline", type=Path, default=DEFAULT_PROGRAMS,
         help="program budgets/fingerprints file "
              "(default: analysis/programs.json)")
@@ -198,9 +352,13 @@ def main(argv=None) -> int:
 
     if args.list_rules:
         from .graftprog import GP_RULES
-        for rule, summary in sorted({**RULES, **GP_RULES}.items()):
+        from .graftshard import GP4_RULES
+        for rule, summary in sorted({**RULES, **GP_RULES,
+                                     **GP4_RULES}.items()):
             print(f"{rule}  {summary}")
         return 0
+    if args.comms:
+        return _comms_main(args)
     # the program-audit flags imply --programs: falling through to the
     # lint path would silently ignore them (a bare `--write-programs`
     # after an intended change would exit 0 having written nothing,

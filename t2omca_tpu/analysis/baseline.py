@@ -105,7 +105,7 @@ def load_programs(path: Path = DEFAULT_PROGRAMS) -> dict:
     reports GP300 — new programs must be consciously accepted)."""
     path = Path(path)
     if not path.exists():
-        return {"platform": None, "programs": {}}
+        return {"platform": None, "programs": {}, "transfers": {}}
     data = json.loads(path.read_text())
     if data.get("version") != PROGRAMS_VERSION:
         raise ValueError(
@@ -113,7 +113,8 @@ def load_programs(path: Path = DEFAULT_PROGRAMS) -> dict:
             f"{data.get('version')!r}, this tool reads version "
             f"{PROGRAMS_VERSION}")
     return {"platform": data.get("platform"),
-            "programs": dict(data.get("programs", {}))}
+            "programs": dict(data.get("programs", {})),
+            "transfers": dict(data.get("transfers", {}))}
 
 
 def save_programs(path: Path, reports, platform: str,
@@ -155,9 +156,89 @@ def save_programs(path: Path, reports, platform: str,
             entry["peak_bytes"] = rep.peak_bytes
         if rules:
             entry["rules"] = rules
+        # the comms section is measured by the OTHER audit level
+        # (--comms, save_comms below) — a regular program-level rewrite
+        # must carry it verbatim, not erase it
+        if "comms" in prev:
+            entry["comms"] = prev["comms"]
         programs[rep.name] = entry
     # entries for programs that no longer register at all are dropped
     # (the CLI's stale warning announced them); skipped ones survive
     payload = {"version": PROGRAMS_VERSION, "platform": platform,
                "programs": programs}
+    transfers = (old or {}).get("transfers", {})
+    if transfers:
+        payload["transfers"] = transfers
+    Path(path).write_text(json.dumps(payload, indent=2) + "\n")
+
+
+def save_comms(path: Path, reports, transfers, platform: str,
+               old: dict | None = None) -> None:
+    """Write the measured comms census as the ``comms`` sections of the
+    existing program entries plus the top-level ``transfers`` table —
+    the ``save_baseline`` contract again: justifications and hand-tuned
+    tolerances survive, new entries get a TODO marker, skipped audits
+    keep their previous section untouched. Everything OUTSIDE the comms
+    sections (fingerprints, budgets, GP2xx rules) is carried verbatim —
+    the comms level must never perturb the program-level baseline."""
+    from .graftshard import COMMS_TOLERANCE
+    old = old or {"platform": platform, "programs": {}, "transfers": {}}
+    programs = {n: dict(e) for n, e in old.get("programs", {}).items()}
+    for rep in sorted(reports, key=lambda r: r.name):
+        if rep.skipped is not None:
+            continue
+        entry = programs.setdefault(rep.name, {})
+        prev = entry.get("comms", {})
+        comms = {
+            "mesh": rep.mesh,
+            "collectives": {
+                kind: {"count": e["count"], "bytes": e["bytes"],
+                       "axes": list(e["axes"])}
+                for kind, e in sorted(rep.census.items())},
+            "bytes": rep.total_bytes,
+            "tolerance": prev.get("tolerance", COMMS_TOLERANCE),
+            "justification": prev.get("justification")
+            or "TODO: justify or fix",
+        }
+        rules = {}
+        for rule in sorted(rep.rule_details):
+            n = rep.rule_count(rule)
+            if n:
+                rules[rule] = {
+                    "count": n,
+                    "justification": prev.get("rules", {}).get(rule, {})
+                    .get("justification") or "TODO: justify or fix",
+                }
+        if rules:
+            comms["rules"] = rules
+        entry["comms"] = comms
+    transfers_out = dict(old.get("transfers", {}))
+    for rep in sorted(transfers, key=lambda r: r.name):
+        if rep.skipped is not None:
+            continue
+        prev = transfers_out.get(rep.name, {})
+        t = {
+            "leaves": rep.leaves,
+            "bytes": rep.bytes,
+            "kind": rep.kind,
+            "tolerance": prev.get("tolerance", COMMS_TOLERANCE),
+            "justification": prev.get("justification")
+            or "TODO: justify or fix",
+        }
+        rules = {}
+        for rule in sorted(rep.rule_details):
+            n = rep.rule_count(rule)
+            if n:
+                rules[rule] = {
+                    "count": n,
+                    "justification": prev.get("rules", {}).get(rule, {})
+                    .get("justification") or "TODO: justify or fix",
+                }
+        if rules:
+            t["rules"] = rules
+        transfers_out[rep.name] = t
+    payload = {"version": PROGRAMS_VERSION, "platform": platform,
+               "programs": programs}
+    if transfers_out:
+        payload["transfers"] = transfers_out
     Path(path).write_text(json.dumps(payload, indent=2) + "\n")

@@ -48,6 +48,12 @@ GL110     A device-boundary wrapper call (``_watched`` / ``_sync_point``
           coverage (and the GL110 check itself) is keyed on that set,
           so an unregistered phase is a dispatch boundary whose hangs
           and failures leave no telemetry trail — register it.
+GL111     Bare ``lock.acquire()`` without ``timeout=`` (or
+          ``blocking=False``) in a liveness-critical module
+          (``LOCK_PATH_GLOBS``: the driver, serve/, the watchdog,
+          obs/): a stuck holder wedges the thread with no watchdog
+          escape — the PR 4 save_lock class. ``with lock:`` is exempt
+          (the idiom for short critical sections).
 ========  ==============================================================
 
 Scope and honesty about limits: "traced code" means functions that are
@@ -87,6 +93,8 @@ RULES: Dict[str, str] = {
     "GL108": "dead import (module-level import never referenced)",
     "GL109": "closure-captured array constant in traced code (bake hazard)",
     "GL110": "device-boundary wrapper phase missing from obs span registry",
+    "GL111": "bare lock acquire() without timeout in a liveness-critical "
+             "module",
 }
 
 #: driver helper names whose first argument is a span/watchdog phase
@@ -108,6 +116,22 @@ HOT_PATH_GLOBS: Tuple[str, ...] = (
     # the kernel layer IS the hot path: a device_get/block_until_ready
     # creeping into a kernel wrapper would stall every rollout scan step
     "t2omca_tpu/kernels/*.py",
+)
+
+#: modules where an unbounded ``lock.acquire()`` is a liveness hazard
+#: (GL111): the driver loop, the serving fleet, the watchdog and the
+#: telemetry plane all hold locks across device dispatches — a bare
+#: acquire there is the PR 4 save_lock wedge class (a stuck holder
+#: silently freezes the process with the watchdog unable to report).
+#: Bounded forms — ``acquire(timeout=...)`` / ``acquire(blocking=False)``
+#: / ``with lock:`` (the context manager is deliberately exempt: it is
+#: the idiom for short critical sections that never span a dispatch) —
+#: are fine. Matched with fnmatch like HOT_PATH_GLOBS.
+LOCK_PATH_GLOBS: Tuple[str, ...] = (
+    "t2omca_tpu/run.py",
+    "t2omca_tpu/serve/*.py",
+    "t2omca_tpu/utils/watchdog.py",
+    "t2omca_tpu/obs/*.py",
 )
 
 # tracing entry points: wrapping one of these around a function makes its
@@ -623,6 +647,39 @@ class _ModuleLinter:
                           f"dispatch pipeline — move to a cadence "
                           f"boundary or baseline with justification")
 
+    def _check_bare_acquire(self) -> None:
+        """GL111: explicit ``<something>.acquire()`` with neither a
+        ``timeout=`` nor ``blocking=False`` in a liveness-critical
+        module (``LOCK_PATH_GLOBS``). A positional first argument is
+        the ``blocking`` flag — ``acquire(False)`` is bounded, any
+        other positional form is treated as unbounded. Name-based:
+        any ``.acquire`` attribute call counts (Lock, RLock,
+        Condition, Semaphore all share the wedge semantics)."""
+        if not any(fnmatch.fnmatch(self.path, g)
+                   for g in LOCK_PATH_GLOBS):
+            return
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"):
+                continue
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            if any(kw.arg == "blocking"
+                   and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is False for kw in node.keywords):
+                continue
+            if (node.args and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value is False):
+                continue
+            self.emit(node, "GL111",
+                      "bare `.acquire()` without a timeout in a "
+                      "liveness-critical module: a stuck holder wedges "
+                      "this thread with no watchdog escape (the PR 4 "
+                      "save_lock class) — pass `timeout=` and handle "
+                      "the False return, use `blocking=False`, or "
+                      "baseline with a justification")
+
     def _check_donation_alias(self) -> None:
         for fns in self.defs.values():
             for fn in fns:
@@ -739,6 +796,7 @@ class _ModuleLinter:
             self._check_traced_function(fn, set(), statics)
             self._check_closure_consts(fn, traced_ids)
         self._check_hot_path()
+        self._check_bare_acquire()
         self._check_donation_alias()
         self._check_dead_imports()
         self._check_span_phases()

@@ -1,0 +1,701 @@
+"""graftshard — collective-traffic & sharding auditor for the mesh
+programs (GP4xx; ROADMAP item 3's static gate).
+
+``graftprog`` ratchets each program's FLOPs/bytes/fingerprint, but those
+are *per-device* views: a stray all-gather that replicates the full
+param tree every step, a donated leaf silently resharded on entry, or a
+params.sync publish that degrades from a device-to-device copy into a
+host round-trip all pass the GP2xx/GP3xx gate with at most an opaque
+bytes wobble. This module audits the **communication structure** of the
+mesh-placed registry programs (``dp_superstep``, ``actor_step``/
+``learner_step``, ``pop_dp_superstep``/``pop_learner_step``, the
+synthetic dp×mp ``dpmp_block``) by compiling them under the fixed audit
+meshes and parsing the partitioned HLO, plus the ``params.sync``
+publish as a static sharding-pair transfer check (a cross-mesh
+``device_put`` never lowers to HLO — the runtime executes it — so its
+audit is the src/dst shard-map comparison, which is exactly the
+property that decides copy-vs-gather).
+
+**Comms rules** (ratcheted against the ``comms``/``transfers`` sections
+of ``analysis/programs.json``):
+
+========  ==============================================================
+GP401     unbaselined collective: an all-reduce / all-gather /
+          reduce-scatter / collective-permute / all-to-all op kind (or
+          occurrence count past the baselined one) appearing in a mesh
+          program — new collectives must be consciously accepted.
+GP402     per-program collective bytes (element-counted from the
+          partitioned HLO result shapes) grew past the entry's
+          tolerance — the interconnect-traffic twin of GP302.
+GP403     replication blowup: an all-gather materializing a tensor at
+          least as large as the program's largest sharded input leaf
+          (full unsharded size) — the accidental-full-gather class.
+GP404     boundary reshard: a donated input leaf whose compiled
+          sharding differs from the sharding the donor was stamped
+          with — or that entered unstamped and was compiled with a
+          sharded entry layout (XLA copies on entry, defeating
+          donation) — or a transfer leaf whose destination shards do
+          not exist verbatim
+          on any source device (the publish degrades to
+          gather/reshard instead of a pure d2d copy).
+GP405     logical-axis-rule violation: a program output whose lowered
+          sharding does not match the sharding its declared logical
+          axes map to under ``parallel/mesh.py LOGICAL_AXIS_RULES`` —
+          the T5X-pattern dry-run gate for the dp×mp partitioner.
+========  ==============================================================
+
+Shrinkage (fewer collectives, smaller bytes) is a stale note, never a
+failure — rerun ``--comms --write-programs`` to tighten, exactly like
+the GP3xx ratchet. Raw mode (``--no-baseline``) reports only the
+structural rules (GP403/404/405); GP401/402 are baseline-relative,
+like GP300-302.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .registry import AuditProgram, SkipProgram, TransferAudit
+
+#: rule id -> one-line summary (full catalog: docs/ANALYSIS.md)
+GP4_RULES: Dict[str, str] = {
+    "GP401": "unbaselined collective op kind/count in a mesh program",
+    "GP402": "collective bytes grew past the baseline tolerance",
+    "GP403": "replication blowup: all-gather materializes a full-size leaf",
+    "GP404": "donated/published leaf resharded at a program boundary",
+    "GP405": "lowered sharding violates a declared logical axis rule",
+}
+
+#: the op kinds the census counts (HLO instruction names, sync form;
+#: async ``-start`` halves are folded in, ``-done`` halves skipped)
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+#: default tolerance written for NEW comms/transfer baseline entries
+#: (collective traffic is structural — tighter than the FLOP budgets)
+COMMS_TOLERANCE = 0.10
+
+_HLO_TYPE_RE = re.compile(r"([a-z][a-z0-9]{1,4})\[([0-9,]*)\]")
+_COLLECTIVE_RE = re.compile(
+    r"=\s+(?P<result>[^=]*?)\s+"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?P<suffix>-start|-done)?\(")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m": 1, "f8e5m": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+
+def _type_bytes(dtype: str, shape_csv: str) -> int:
+    n = 1
+    for d in shape_csv.split(","):
+        if d.strip():
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+# ------------------------------------------------------- replica groups
+
+def _iota_groups(g: int, s: int, dims: List[int],
+                 perm: Optional[List[int]]) -> List[List[int]]:
+    """Decode HLO iota replica groups ``[g,s]<=[dims]T(perm)``: device
+    order is iota over ``dims`` (optionally transposed), reshaped to
+    ``g`` groups of ``s``."""
+    import numpy as np
+    order = np.arange(int(np.prod(dims))).reshape(dims)
+    if perm is not None:
+        order = order.transpose(perm)
+    return order.reshape(g, s).tolist()
+
+
+def _parse_groups(line: str) -> Optional[List[List[int]]]:
+    m = re.search(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}", line)
+    if m:
+        return [[int(d) for d in grp.split(",") if d.strip()]
+                for grp in re.findall(r"\{([^}]*)\}", m.group(1))]
+    m = re.search(
+        r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\]"
+        r"(?:T\(([\d,]+)\))?", line)
+    if m:
+        g, s = int(m.group(1)), int(m.group(2))
+        dims = [int(d) for d in m.group(3).split(",")]
+        perm = ([int(d) for d in m.group(4).split(",")]
+                if m.group(4) else None)
+        return _iota_groups(g, s, dims, perm)
+    return None
+
+
+def _axis_groups(mesh_shape: Tuple[int, ...], axis: int) -> set:
+    """The group set a collective running along exactly ``axis`` of a
+    mesh of ``mesh_shape`` logical devices would carry."""
+    import numpy as np
+    idx = np.arange(int(np.prod(mesh_shape))).reshape(mesh_shape)
+    moved = np.moveaxis(idx, axis, -1).reshape(-1, mesh_shape[axis])
+    return set(frozenset(row) for row in moved.tolist())
+
+
+def axis_label(groups: Optional[List[List[int]]],
+               mesh_shape: Tuple[int, ...],
+               axis_names: Tuple[str, ...]) -> str:
+    """Attribute a replica-group set to a mesh axis name: the axis whose
+    group pattern matches, ``+``-joined names when one group spans the
+    whole mesh, ``mixed`` otherwise."""
+    import numpy as np
+    n = int(np.prod(mesh_shape))
+    if not groups:
+        return "?"
+    gset = set(frozenset(g) for g in groups)
+    if gset == {frozenset(range(n))}:
+        return "+".join(axis_names) if len(axis_names) > 1 else \
+            axis_names[0]
+    for k, name in enumerate(axis_names):
+        if mesh_shape[k] > 1 and gset == _axis_groups(mesh_shape, k):
+            return name
+    return "mixed"
+
+
+def _permute_label(line: str, mesh_shape: Tuple[int, ...],
+                   axis_names: Tuple[str, ...]) -> str:
+    """collective-permute carries source_target_pairs, not groups: the
+    axis is the one along which every pair's mesh coordinates differ."""
+    import numpy as np
+    m = re.search(r"source_target_pairs=\{([^}]*(?:\},\{[^}]*)*)\}", line)
+    if not m:
+        return "?"
+    pairs = re.findall(r"\{(\d+),(\d+)\}", line)
+    if not pairs:
+        return "?"
+    axes = set()
+    for a, b in pairs:
+        ca = np.unravel_index(int(a), mesh_shape)
+        cb = np.unravel_index(int(b), mesh_shape)
+        diff = [k for k in range(len(mesh_shape)) if ca[k] != cb[k]]
+        axes.add(tuple(diff))
+    if all(len(d) == 1 for d in axes):
+        names = {axis_names[d[0]] for d in axes}
+        if len(names) == 1:
+            return names.pop()
+    return "mixed"
+
+
+# ---------------------------------------------------------------- census
+
+def parse_collectives(hlo_text: str, mesh_shape: Tuple[int, ...],
+                      axis_names: Tuple[str, ...]) -> Dict[str, dict]:
+    """Partitioned-HLO text -> census ``{op kind: {"count", "bytes",
+    "axes"}}``. Bytes are element-counted from each op's RESULT types
+    (tuple results summed); axes are attributed from replica groups /
+    source-target pairs against the program's logical mesh shape.
+    ``-done`` halves of async pairs are skipped (their ``-start`` was
+    counted), so a future async CPU lowering can't double-count."""
+    census: Dict[str, dict] = {}
+    biggest: Dict[str, int] = {}
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if m is None or m.group("suffix") == "-done":
+            continue
+        op = m.group("op")
+        nbytes = sum(_type_bytes(d, s)
+                     for d, s in _HLO_TYPE_RE.findall(m.group("result")))
+        if op == "collective-permute":
+            label = _permute_label(line, mesh_shape, axis_names)
+        else:
+            label = axis_label(_parse_groups(line), mesh_shape,
+                               axis_names)
+        e = census.setdefault(op, {"count": 0, "bytes": 0, "axes": []})
+        e["count"] += 1
+        e["bytes"] += nbytes
+        if label not in e["axes"]:
+            e["axes"].append(label)
+        biggest[op] = max(biggest.get(op, 0), nbytes)
+    for e in census.values():
+        e["axes"] = sorted(e["axes"])
+    return census
+
+
+def census_bytes(census: Dict[str, dict]) -> int:
+    return sum(e["bytes"] for e in census.values())
+
+
+def _gather_blowups(hlo_text: str, threshold: int) -> List[str]:
+    """GP403 detail lines: all-gathers whose result is at least
+    ``threshold`` bytes (the largest sharded input leaf's full
+    unsharded size)."""
+    out = []
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if (m is None or m.group("op") != "all-gather"
+                or m.group("suffix") == "-done"):
+            continue
+        types = _HLO_TYPE_RE.findall(m.group("result"))
+        nbytes = sum(_type_bytes(d, s) for d, s in types)
+        if nbytes >= threshold:
+            shapes = ", ".join(f"{d}[{s}]" for d, s in types)
+            out.append(
+                f"all-gather materializes {shapes} ({nbytes} bytes) — at "
+                f"least the program's largest sharded input leaf "
+                f"({threshold} bytes) re-assembled whole on every "
+                f"device (accidental full gather)")
+    return out
+
+
+# --------------------------------------------------------------- reports
+
+@dataclasses.dataclass
+class CommsReport:
+    """Everything the comms audit measured about one mesh program."""
+
+    name: str
+    census: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    total_bytes: int = 0
+    mesh: str = ""                      # e.g. "2x2 (data, model)"
+    #: rule -> per-occurrence detail messages (GP403/404/405)
+    rule_details: Dict[str, List[str]] = dataclasses.field(
+        default_factory=dict)
+    skipped: Optional[str] = None
+
+    def rule_count(self, rule: str) -> int:
+        return len(self.rule_details.get(rule, []))
+
+
+@dataclasses.dataclass
+class TransferReport:
+    """The static src→dst sharding-pair audit of one registered
+    transfer (the params.sync publish class)."""
+
+    name: str
+    leaves: int = 0
+    bytes: int = 0
+    #: "d2d-copy" | "local" | "reshard" (worst leaf wins)
+    kind: str = "d2d-copy"
+    rule_details: Dict[str, List[str]] = dataclasses.field(
+        default_factory=dict)
+    skipped: Optional[str] = None
+
+    def rule_count(self, rule: str) -> int:
+        return len(self.rule_details.get(rule, []))
+
+
+# ----------------------------------------------------- program selection
+
+def _named_sharding(leaf):
+    from jax.sharding import NamedSharding
+    sh = getattr(leaf, "sharding", None)
+    return sh if isinstance(sh, NamedSharding) else None
+
+
+def is_mesh_program(prog: AuditProgram) -> bool:
+    """A program enters the comms audit iff any example-argument leaf is
+    stamped with a NamedSharding — that's what makes it mesh-placed."""
+    import jax
+    if prog.skip is not None:
+        return True          # skips surface as stale notes, never drop
+    return any(_named_sharding(l) is not None
+               for l in jax.tree_util.tree_leaves(prog.args))
+
+
+def _program_mesh(prog: AuditProgram):
+    """(shape tuple, axis names) of the first stamped NamedSharding —
+    the logical mesh the census attributes collectives against."""
+    import jax
+    for leaf in jax.tree_util.tree_leaves(prog.args):
+        sh = _named_sharding(leaf)
+        if sh is not None:
+            mesh = sh.mesh
+            return tuple(mesh.shape[a] for a in mesh.axis_names), \
+                tuple(mesh.axis_names)
+    return (1,), ("?",)
+
+
+# ----------------------------------------------------------------- audit
+
+def _resharded_donations(prog: AuditProgram, compiled) -> List[str]:
+    """GP404 (program form): donated arg leaves whose compiled input
+    sharding is not equivalent to the stamped one — the runtime copies
+    the buffer into the new layout on entry, and a copied buffer cannot
+    be donated in place."""
+    import jax
+    from jax.sharding import Sharding
+    in_sh = compiled.input_shardings[0]
+    out: List[str] = []
+    for i in prog.donate_argnums:
+        if i >= len(prog.args):
+            continue
+        leaves = jax.tree_util.tree_leaves(prog.args[i])
+        shs = jax.tree_util.tree_leaves(
+            in_sh[i], is_leaf=lambda x: isinstance(x, Sharding))
+        for leaf, got in zip(leaves, shs):
+            want = _named_sharding(leaf)
+            if want is None:
+                # No declared placement: GSPMD is free to pick the entry
+                # layout, and when it picks a sharded one the caller's
+                # (undeclared) buffer is resharded on dispatch — the
+                # donation frees the copy, not the original.
+                if got is not None and not got.is_fully_replicated:
+                    out.append(
+                        f"donated leaf {getattr(leaf, 'dtype', '?')}"
+                        f"{list(getattr(leaf, 'shape', ()))} has no "
+                        f"stamped sharding but compiled with sharded "
+                        f"entry layout {got} — the dispatch-time reshard "
+                        f"copy defeats donation")
+                continue
+            ndim = len(getattr(leaf, "shape", ()))
+            if not want.is_equivalent_to(got, ndim):
+                out.append(
+                    f"donated leaf {getattr(leaf, 'dtype', '?')}"
+                    f"{list(getattr(leaf, 'shape', ()))} stamped "
+                    f"{want.spec} but compiled as {got} — resharded on "
+                    f"entry, the silent copy defeats donation")
+    return out
+
+
+def _logical_violations(prog: AuditProgram, compiled) -> List[str]:
+    """GP405: declared expected output shardings
+    (``AuditProgram.expected_output_shardings``, built from
+    ``parallel/mesh.py LOGICAL_AXIS_RULES``) vs what lowering chose."""
+    import jax
+    from jax.sharding import Sharding
+    expected = prog.expected_output_shardings
+    if expected is None:
+        return []
+    got_tree = compiled.output_shardings
+    exp_leaves = jax.tree_util.tree_leaves(
+        expected, is_leaf=lambda x: isinstance(x, Sharding))
+    got_leaves = jax.tree_util.tree_leaves(
+        got_tree, is_leaf=lambda x: isinstance(x, Sharding))
+    out: List[str] = []
+    if len(exp_leaves) != len(got_leaves):
+        return [f"declared {len(exp_leaves)} output sharding leaves but "
+                f"the program lowered {len(got_leaves)} — the logical "
+                f"spec no longer matches the program's output structure"]
+    for i, (want, got) in enumerate(zip(exp_leaves, got_leaves)):
+        if want is None:
+            continue
+        ndim = len(want.spec) if hasattr(want, "spec") else 0
+        try:
+            ok = want.is_equivalent_to(got, ndim)
+        except Exception:  # noqa: BLE001 — differing sharding classes
+            ok = False
+        if not ok:
+            out.append(
+                f"output leaf {i} lowered as {got} but LOGICAL_AXIS_"
+                f"RULES declare {want.spec} — the partitioner dry-run "
+                f"gate (docs/ANALYSIS.md GP405)")
+    return out
+
+
+def lower_comms_program(name: str, prog: AuditProgram):
+    """Phase 1 (serial): trace + lower one mesh program. Returns the
+    (report, lowered, traced) triple; ``lowered`` is None when the
+    program skipped."""
+    report = CommsReport(name=name)
+    if prog.skip is not None:
+        report.skipped = prog.skip
+        return report, None
+    try:
+        traced = prog.fn.trace(*prog.args, **prog.kwargs)
+    except SkipProgram as e:
+        report.skipped = str(e)
+        return report, None
+    return report, traced.lower()
+
+
+def finish_comms_program(report: CommsReport, prog: AuditProgram,
+                         compiled) -> CommsReport:
+    """Phase 2: parse the partitioned HLO of the compiled program and
+    run every comms rule."""
+    import jax
+    shape, names = _program_mesh(prog)
+    report.mesh = "x".join(str(s) for s in shape) + f" ({', '.join(names)})"
+    text = compiled.as_text()
+    report.census = parse_collectives(text, shape, names)
+    report.total_bytes = census_bytes(report.census)
+
+    details: Dict[str, List[str]] = {}
+    sharded_bytes = [
+        _leaf_bytes(l) for l in jax.tree_util.tree_leaves(prog.args)
+        if (sh := _named_sharding(l)) is not None
+        and not sh.is_fully_replicated]
+    if sharded_bytes:
+        if (d := _gather_blowups(text, max(sharded_bytes))):
+            details["GP403"] = d
+    if prog.donate_argnums:
+        if (d := _resharded_donations(prog, compiled)):
+            details["GP404"] = d
+    if (d := _logical_violations(prog, compiled)):
+        details["GP405"] = d
+    report.rule_details = details
+    return report
+
+
+def _leaf_bytes(leaf) -> int:
+    import numpy as np
+    shape = getattr(leaf, "shape", ())
+    dtype = getattr(leaf, "dtype", None)
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * (np.dtype(dtype).itemsize if dtype is not None else 4)
+
+
+def audit_comms_registry(progs: Dict[str, AuditProgram],
+                         workers: int = 2) -> List[CommsReport]:
+    """Audit every mesh program: lower serially (tracing shares global
+    jax state), compile concurrently (XLA releases the GIL — on the
+    2-core gate box this roughly halves the dominant compile phase),
+    then parse each partitioned module."""
+    from concurrent.futures import ThreadPoolExecutor
+    lowered: List[Tuple[CommsReport, AuditProgram, object]] = []
+    for name, prog in progs.items():
+        rep, lo = lower_comms_program(name, prog)
+        lowered.append((rep, prog, lo))
+    with ThreadPoolExecutor(max_workers=max(1, workers)) as pool:
+        compiled = list(pool.map(
+            lambda t: None if t[2] is None else t[2].compile(), lowered))
+    out: List[CommsReport] = []
+    for (rep, prog, _), co in zip(lowered, compiled):
+        out.append(rep if co is None
+                   else finish_comms_program(rep, prog, co))
+    return out
+
+
+# -------------------------------------------------------------- transfers
+
+def _canon_index(idx, shape) -> tuple:
+    """Canonical hashable form of a devices_indices_map value: a tuple
+    of (start, stop) per dimension with slices resolved."""
+    out = []
+    for sl, dim in zip(idx, shape):
+        start, stop, step = sl.indices(dim)
+        out.append((start, stop, step))
+    return tuple(out)
+
+
+def audit_transfer(name: str, ta: TransferAudit) -> TransferReport:
+    """Static transfer census: per leaf, compare the source sharding's
+    device→index map against the destination's. A destination shard
+    that exists verbatim on some source device is a pure device-to-
+    device copy (or free, when the destination device already holds
+    it); anything else forces a gather/reshard on the publish path —
+    the GP404 host-round-trip class. Nothing is executed or lowered."""
+    import jax
+    report = TransferReport(name=name)
+    if ta.skip is not None:
+        report.skipped = ta.skip
+        return report
+    src_leaves = jax.tree_util.tree_leaves(ta.src)
+    dst_leaves = jax.tree_util.tree_leaves(
+        ta.dst_shardings,
+        is_leaf=lambda x: hasattr(x, "devices_indices_map"))
+    kinds = {"local": 0, "d2d-copy": 0, "reshard": 0}
+    details: List[str] = []
+    moved = 0
+    for leaf, dst_sh in zip(src_leaves, dst_leaves):
+        shape = tuple(leaf.shape)
+        src_sh = _named_sharding(leaf)
+        if src_sh is None:
+            continue
+        src_map = {}
+        for dev, idx in src_sh.devices_indices_map(shape).items():
+            src_map.setdefault(_canon_index(idx, shape), set()).add(dev)
+        leaf_kind = "local"
+        n_elems = 1
+        for d in shape:
+            n_elems *= int(d)
+        itemsize = _leaf_bytes(leaf) // max(1, n_elems)
+        for dev, idx in dst_sh.devices_indices_map(shape).items():
+            c = _canon_index(idx, shape)
+            holders = src_map.get(c)
+            if holders is None:
+                leaf_kind = "reshard"
+                break
+            if dev in holders:
+                continue                       # already in place, free
+            leaf_kind = max(leaf_kind, "d2d-copy",
+                            key=["local", "d2d-copy", "reshard"].index)
+            moved += _shard_bytes(c, itemsize)
+        kinds[leaf_kind] += 1
+        if leaf_kind == "reshard":
+            details.append(
+                f"leaf {leaf.dtype}{list(shape)}: destination shard "
+                f"({dst_sh}) does not exist verbatim on any source "
+                f"device ({src_sh}) — the publish lowers as a "
+                f"gather/reshard (host round-trip risk), not a pure "
+                f"d2d copy")
+    report.leaves = sum(kinds.values())
+    report.bytes = moved
+    report.kind = ("reshard" if kinds["reshard"] else
+                   "d2d-copy" if kinds["d2d-copy"] else "local")
+    if details:
+        report.rule_details = {"GP404": details}
+    return report
+
+
+def _shard_bytes(canon_idx: tuple, itemsize: int) -> int:
+    n = 1
+    for start, stop, step in canon_idx:
+        n *= max(0, (stop - start + step - 1) // step)
+    return max(n, 1) * itemsize
+
+
+# ----------------------------------------------------------------- ratchet
+
+def _ProgFinding(program: str, rule: str, message: str):
+    from .graftprog import ProgFinding
+    return ProgFinding(program, rule, message)
+
+
+def compare_comms(reports: List[CommsReport],
+                  transfers: List[TransferReport],
+                  baseline: dict) -> Tuple[List[object], List[str]]:
+    """-> (new_findings, stale_notes) against the ``comms`` sections of
+    programs.json entries plus its top-level ``transfers`` table — the
+    graftprog ratchet contract (regressions past tolerance fail,
+    improvements and vanished entries warn)."""
+    findings: List[object] = []
+    stale: List[str] = []
+    base_programs = baseline.get("programs", {})
+    base_transfers = baseline.get("transfers", {})
+    seen = set()
+    for rep in reports:
+        seen.add(rep.name)
+        if rep.skipped is not None:
+            stale.append(f"{rep.name}: skipped ({rep.skipped})")
+            continue
+        entry = base_programs.get(rep.name, {})
+        comms = entry.get("comms")
+        if comms is None:
+            if rep.census or rep.rule_details:
+                for kind, e in sorted(rep.census.items()):
+                    findings.append(_ProgFinding(
+                        rep.name, "GP401",
+                        f"no comms baseline — {e['count']}x {kind} "
+                        f"({e['bytes']} bytes, axes "
+                        f"{'/'.join(e['axes'])}) unaccounted; accept "
+                        f"with --comms --write-programs (plus a "
+                        f"justification)"))
+                for rule, msgs in sorted(rep.rule_details.items()):
+                    findings.extend(_ProgFinding(rep.name, rule, m)
+                                    for m in msgs)
+            continue
+        base_census = comms.get("collectives", {})
+        for kind, e in sorted(rep.census.items()):
+            allowed = int(base_census.get(kind, {}).get("count", 0))
+            if e["count"] > allowed:
+                findings.append(_ProgFinding(
+                    rep.name, "GP401",
+                    f"{e['count']}x {kind} > {allowed} baselined "
+                    f"({e['bytes']} bytes, axes {'/'.join(e['axes'])}) "
+                    f"— a new collective moved into this program; "
+                    f"justify and --comms --write-programs, or fix"))
+            elif e["count"] < allowed:
+                stale.append(f"{rep.name}: {kind} count dropped "
+                             f"{allowed} -> {e['count']} (rerun --comms "
+                             f"--write-programs to tighten)")
+        for kind in sorted(set(base_census) - set(rep.census)):
+            stale.append(f"{rep.name}: baselined collective {kind} no "
+                         f"longer present (rerun --comms "
+                         f"--write-programs to tighten)")
+        tol = float(comms.get("tolerance", COMMS_TOLERANCE))
+        base_bytes = comms.get("bytes")
+        if base_bytes is not None and base_bytes > 0:
+            if rep.total_bytes > base_bytes * (1.0 + tol):
+                findings.append(_ProgFinding(
+                    rep.name, "GP402",
+                    f"collective bytes {rep.total_bytes} > baselined "
+                    f"{base_bytes} (+{(rep.total_bytes / base_bytes - 1) * 100:.1f}%,"
+                    f" tolerance {tol * 100:.0f}%) — justify and "
+                    f"--comms --write-programs, or fix the regression"))
+            elif rep.total_bytes < base_bytes * (1.0 - tol):
+                stale.append(f"{rep.name}: collective bytes improved "
+                             f"{base_bytes} -> {rep.total_bytes} (rerun "
+                             f"--comms --write-programs to tighten)")
+        elif base_bytes in (None, 0) and rep.total_bytes:
+            # kinds were baselined but bytes never — treat as growth
+            # from zero past any tolerance
+            findings.append(_ProgFinding(
+                rep.name, "GP402",
+                f"collective bytes {rep.total_bytes} with no byte "
+                f"budget baselined — --comms --write-programs"))
+        _rule_ratchet(findings, stale, rep, comms.get("rules", {}),
+                      "--comms --write-programs")
+    for name in sorted(n for n, e in base_programs.items()
+                       if "comms" in e and n not in seen):
+        stale.append(f"{name}: baselined comms entry no longer audited")
+
+    tseen = set()
+    for rep in transfers:
+        tseen.add(rep.name)
+        if rep.skipped is not None:
+            stale.append(f"{rep.name}: skipped ({rep.skipped})")
+            continue
+        entry = base_transfers.get(rep.name)
+        if entry is None:
+            findings.append(_ProgFinding(
+                rep.name, "GP401",
+                f"transfer has no baseline entry ({rep.leaves} leaves, "
+                f"{rep.bytes} bytes, kind {rep.kind}) — accept with "
+                f"--comms --write-programs (plus a justification)"))
+            for rule, msgs in sorted(rep.rule_details.items()):
+                findings.extend(_ProgFinding(rep.name, rule, m)
+                                for m in msgs)
+            continue
+        if rep.kind != entry.get("kind"):
+            findings.append(_ProgFinding(
+                rep.name, "GP401",
+                f"transfer kind changed {entry.get('kind')!r} -> "
+                f"{rep.kind!r} — the publish no longer moves the way "
+                f"the baseline promises"))
+        tol = float(entry.get("tolerance", COMMS_TOLERANCE))
+        base_bytes = entry.get("bytes", 0)
+        if base_bytes and rep.bytes > base_bytes * (1.0 + tol):
+            findings.append(_ProgFinding(
+                rep.name, "GP402",
+                f"transfer bytes {rep.bytes} > baselined {base_bytes} "
+                f"(+{(rep.bytes / base_bytes - 1) * 100:.1f}%, tolerance "
+                f"{tol * 100:.0f}%)"))
+        elif base_bytes and rep.bytes < base_bytes * (1.0 - tol):
+            stale.append(f"{rep.name}: transfer bytes improved "
+                         f"{base_bytes} -> {rep.bytes} (rerun --comms "
+                         f"--write-programs to tighten)")
+        _rule_ratchet(findings, stale, rep, entry.get("rules", {}),
+                      "--comms --write-programs")
+    for name in sorted(set(base_transfers) - tseen):
+        stale.append(f"{name}: baselined transfer no longer registered")
+    return findings, stale
+
+
+def _rule_ratchet(findings, stale, rep, base_rules: dict,
+                  accept_hint: str) -> None:
+    for rule in ("GP403", "GP404", "GP405"):
+        allowed = int(base_rules.get(rule, {}).get("count", 0))
+        msgs = rep.rule_details.get(rule, [])
+        if len(msgs) > allowed:
+            for m in msgs[allowed:]:
+                findings.append(_ProgFinding(rep.name, rule, m))
+            findings.append(_ProgFinding(
+                rep.name, rule,
+                f"{len(msgs)} occurrence(s) > {allowed} baselined"))
+        elif len(msgs) < allowed:
+            stale.append(f"{rep.name}: {rule} count dropped {allowed} "
+                         f"-> {len(msgs)} (fixed? rerun {accept_hint} "
+                         f"to tighten)")
+
+
+def raw_findings(reports: List[CommsReport],
+                 transfers: List[TransferReport]) -> List[object]:
+    """``--no-baseline`` mode: only the structural rules (GP403/404/405)
+    are meaningful without a baseline — GP401/402 are ratchets, exactly
+    like GP300-302 in the program audit."""
+    out: List[object] = []
+    for rep in list(reports) + list(transfers):
+        if rep.skipped is not None:
+            continue
+        for rule, msgs in sorted(rep.rule_details.items()):
+            out.extend(_ProgFinding(rep.name, rule, m) for m in msgs)
+    return out

@@ -64,10 +64,39 @@ class AuditProgram:
     #: set when the program cannot be built in this environment; the
     #: auditor records the reason instead of tracing
     skip: Optional[str] = None
+    #: declared output shardings (a pytree of ``NamedSharding``/None
+    #: matching the program's outputs) derived from
+    #: ``parallel.mesh.LOGICAL_AXIS_RULES`` — when set, the comms audit
+    #: (``--comms``) checks the compiled ``output_shardings`` against it
+    #: (GP405, the partitioner dry-run gate). ``None`` = not declared.
+    expected_output_shardings: object = None
 
     @classmethod
     def skipped(cls, reason: str) -> "AuditProgram":
         return cls(fn=None, skip=reason)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransferAudit:
+    """One named cross-mesh transfer (the ``params.sync`` publish
+    class). A cross-mesh ``jax.device_put`` never lowers to HLO — the
+    runtime executes it directly — so its audit is the static
+    src-sharding → dst-sharding comparison (``graftshard.
+    audit_transfer``): ``src`` is a pytree of ShapeDtypeStructs stamped
+    with the SOURCE shardings (the learner-mesh layout the donor
+    produces), ``dst_shardings`` the matching pytree of destination
+    ``Sharding``\\ s (what the publish requests). The audit classifies
+    every leaf as local / pure d2d copy / reshard — reshard is the
+    GP404 host-round-trip class."""
+
+    src: object = None
+    dst_shardings: object = None
+    description: str = ""
+    skip: Optional[str] = None
+
+    @classmethod
+    def skipped(cls, reason: str) -> "TransferAudit":
+        return cls(skip=reason)
 
 
 @dataclasses.dataclass
@@ -153,6 +182,10 @@ TRACE_SYMBOLS = {
     # the single-member superstep
     "superstep_pop": ("jit__superstep_pop",
                       "PjitFunction(_superstep_pop)"),
+    # graftshard dp×mp dry-run block (parallel/mesh.py dpmp_block): a
+    # standalone audit-only dispatch — never fused into a driver trace,
+    # so attribution cannot double-count
+    "dpmp_block": ("jit__dpmp_block", "PjitFunction(_dpmp_block)"),
 }
 
 
@@ -387,6 +420,44 @@ def collect_default_programs() -> Registry:
                     f"({mod.__name__} collides with an earlier hook)")
             reg[name] = prog
     return reg
+
+
+def required_audit_devices() -> int:
+    """The host-device count the FULL default registry needs: the
+    largest fixed audit mesh any hook builds. Baseline writes
+    (``--write-programs``) refuse to run below this — a 2-device run
+    would silently drop the 4-device pop_dp / sebulba / dp×mp entries
+    from programs.json (the same silent-shrink bug class the ``--only``
+    refusal from the graftprog CLI guards against)."""
+    from ..parallel import mesh as mesh_mod
+    from ..parallel import sebulba as sebulba_mod
+    dpmp = 1
+    for d in getattr(mesh_mod, "AUDIT_DPMP_MESH", ()):
+        dpmp *= d
+    return max(mesh_mod.AUDIT_MESH_DEVICES,
+               sum(sebulba_mod.AUDIT_SPLIT), dpmp)
+
+
+def collect_transfer_audits() -> Dict[str, TransferAudit]:
+    """Gather every registered cross-mesh transfer from the component
+    ``register_transfer_audits(ctx)`` hooks — today only the Sebulba
+    params.sync publish, but the hook shape mirrors
+    ``collect_default_programs`` so new publish paths (fleet hot param
+    refresh, dp×mp resharding sync) register next to it."""
+    from ..parallel import sebulba as sebulba_mod
+
+    out: Dict[str, TransferAudit] = {}
+    ctx = audit_context()
+    for mod in (sebulba_mod,):
+        hook = getattr(mod, "register_transfer_audits", None)
+        if hook is None:
+            continue
+        for name, ta in hook(ctx).items():
+            if name in out:
+                raise ValueError(
+                    f"transfer audit {name!r} registered twice")
+            out[name] = ta
+    return out
 
 
 def load_programs_from(path_or_module: str) -> Registry:

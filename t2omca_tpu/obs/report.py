@@ -483,6 +483,39 @@ def sebulba_utilization(events: List[dict],
     return out
 
 
+def render_comms_census(base: dict) -> List[str]:
+    """Per-program collective census from the graftshard ``comms``
+    sections of programs.json plus its ``transfers`` table — the static
+    interconnect view joined into the report so "where did the time go"
+    sits next to "what moves between devices each dispatch". Purely a
+    baseline read (no jax, nothing compiled); empty when the baseline
+    predates the comms audit (``--comms --write-programs``)."""
+    comms = {n: e["comms"]
+             for n, e in sorted(base.get("programs", {}).items())
+             if "comms" in e}
+    transfers = base.get("transfers", {})
+    if not comms and not transfers:
+        return []
+    lines = ["", "collective census (graftshard --comms: static, "
+                 "per dispatch, on the fixed audit meshes)"]
+    hdr = (f"{'program':<17}{'mesh':<16}"
+           f"{'collectives (count x kind[axes])':<40}{'bytes':>9}")
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for name, c in comms.items():
+        cols = ", ".join(
+            f"{e['count']}x {kind}[{'/'.join(e['axes'])}]"
+            for kind, e in sorted(c.get("collectives", {}).items())) \
+            or "none"
+        lines.append(f"{name:<17}{c.get('mesh', '-'):<16}{cols:<40}"
+                     f"{c.get('bytes', 0):>9}")
+    for name, t in sorted(transfers.items()):
+        what = f"{t.get('leaves', 0)} leaves, {t.get('kind', '?')}"
+        lines.append(f"{name:<17}{'transfer':<16}{what:<40}"
+                     f"{t.get('bytes', 0):>9}")
+    return lines
+
+
 def report_main(run_dir: str, programs_json: Optional[str] = None,
                 peak_gflops: Optional[float] = None,
                 peak_gbps: Optional[float] = None) -> int:
@@ -520,6 +553,9 @@ def report_main(run_dir: str, programs_json: Optional[str] = None,
                       base["programs"], run_header(events))
     print(render(run_dir, events, rows, phases, run_header(events),
                  peak_gflops, peak_gbps))
+    census = render_comms_census(base)
+    if census:
+        print("\n".join(census))
     # graftsight section: a run recorded with obs.sight.enabled carries
     # learning-dynamics keys in metrics.jsonl — append the learning-
     # health read so one `obs report` answers both "where did the time

@@ -252,6 +252,104 @@ class DataParallel:
 #: expose beyond two
 AUDIT_MESH_DEVICES = 2
 
+# --------------------------------------------------------------- dp × mp
+#
+# ROADMAP item 3: T5X-style 2-D (dp, mp) partitioning. The PARTITIONER
+# is not built yet — what lives here is its declared-intent artifact
+# (the logical axis rules, SNIPPETS.md [2]/[3] pattern) plus the fixed
+# synthetic 2×2 audit mesh the comms gate (analysis/graftshard.py,
+# GP405) dry-runs a transformer block under, so sharding regressions
+# against the declared rules fail statically before the first real
+# dp×mp line is written.
+
+#: logical axis name -> mesh axis (None = replicated). First match
+#: wins, T5X `logical_axis_rules` semantics. The model axes that grow
+#: with entity-transformer width ("joined_kv": the fused heads*head_dim
+#: projection output of the full-emb head geometry Q1; "mlp": the
+#: ff_hidden_mult*emb hidden) shard over ``model``; "embed" stays
+#: replicated (it is every block's residual/LayerNorm axis — splitting
+#: it would put a collective inside every residual add); "batch"
+#: follows the data axis like every env-lane tensor.
+LOGICAL_AXIS_RULES = (
+    ("batch", "data"),
+    ("heads", "model"),
+    ("joined_kv", "model"),
+    ("mlp", "model"),
+    ("embed", None),
+    ("tokens", None),
+    ("kv", None),
+)
+
+#: the fixed synthetic (dp, mp) audit mesh shape — 2×2 is the smallest
+#: mesh where BOTH axes are real, so the lowered program carries the
+#: genuine dp psum AND mp contraction collectives
+AUDIT_DPMP_MESH = (2, 2)
+
+
+def make_dpmp_mesh(shape: Sequence[int] = AUDIT_DPMP_MESH) -> Mesh:
+    """2-D ("data", "model") mesh over the first prod(shape) devices."""
+    need = int(np.prod(shape))
+    devs = jax.devices()
+    if len(devs) < need:
+        raise ValueError(
+            f"dp x mp mesh {tuple(shape)} needs {need} devices, have "
+            f"{len(devs)} (hint: XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={need})")
+    return Mesh(np.asarray(devs[:need]).reshape(tuple(shape)),
+                ("data", "model"))
+
+
+def logical_to_mesh_axes(logical_axes: Sequence[Optional[str]]) -> P:
+    """Logical axis names -> PartitionSpec under ``LOGICAL_AXIS_RULES``
+    (first match wins; unknown names are an error — an unmapped axis is
+    a rules-table gap, not a replication decision)."""
+    out = []
+    for name in logical_axes:
+        if name is None:
+            out.append(None)
+            continue
+        for logical, mesh_axis in LOGICAL_AXIS_RULES:
+            if logical == name:
+                out.append(mesh_axis)
+                break
+        else:
+            raise ValueError(
+                f"logical axis {name!r} has no LOGICAL_AXIS_RULES entry "
+                f"(parallel/mesh.py) — declare it before sharding by it")
+    return P(*out)
+
+
+def transformer_block_logical_axes(params) -> object:
+    """Logical-axes pytree (tuples of axis names, one per leaf) for a
+    ``models.transformer.TransformerBlock`` param tree — the declared
+    sharding intent GP405 validates lowered programs against. Matches
+    by the flax module-path names, so a renamed/added projection fails
+    loudly here instead of silently replicating."""
+    import jax.tree_util as jtu
+
+    def axes_for(path, leaf):
+        names = [str(getattr(k, "key", k)) for k in path]
+        leaf_name = names[-1]
+        if any(n in ("tokeys", "toqueries", "tovalues") for n in names):
+            return ("embed", "joined_kv")
+        if "unifyheads" in names:
+            return (("joined_kv", "embed") if leaf_name == "kernel"
+                    else ("embed",))
+        if "ff1" in names:
+            return (("embed", "mlp") if leaf_name == "kernel"
+                    else ("mlp",))
+        if "ff2" in names:
+            return (("mlp", "embed") if leaf_name == "kernel"
+                    else ("embed",))
+        if any(n.startswith("norm") for n in names):
+            return ("embed",)
+        raise ValueError(
+            f"TransformerBlock param {'/'.join(names)!r} has no logical-"
+            f"axes mapping (parallel/mesh.py transformer_block_logical_"
+            f"axes) — extend the table before sharding the new module")
+
+    return jtu.tree_map_with_path(axes_for, params)
+
 
 def register_audit_programs(ctx):
     """graftprog registry hook: the data-parallel superstep under a
@@ -266,7 +364,8 @@ def register_audit_programs(ctx):
             f"needs >= {AUDIT_MESH_DEVICES} devices (hint: XLA_FLAGS="
             f"--xla_force_host_platform_device_count="
             f"{AUDIT_MESH_DEVICES})")
-        return {"dp_superstep": skip, "pop_dp_superstep": skip}
+        return {"dp_superstep": skip, "pop_dp_superstep": skip,
+                **_dpmp_block_twin(ctx)}
     dp = DataParallel(ctx.exp, make_mesh(AUDIT_MESH_DEVICES))
     k = ctx.superstep_k
     sup = dp.superstep_program(k, donate=True)
@@ -279,7 +378,67 @@ def register_audit_programs(ctx):
             description=f"fused K={k} superstep sharded over a "
                         f"{AUDIT_MESH_DEVICES}-device data axis"),
         **_pop_dp_twin(k, key),
+        **_dpmp_block_twin(ctx),
     }
+
+
+def _dpmp_block_twin(ctx):
+    """The dp×mp dry-run audit entry (graftshard / ROADMAP item 3): a
+    ``TransformerBlock`` at the audit model scale lowered under the
+    fixed 2×2 ("data", "model") mesh with every param leaf stamped from
+    ``LOGICAL_AXIS_RULES`` via ``transformer_block_logical_axes`` and
+    activations on ("batch", "tokens", "embed"). The program's
+    ``expected_output_shardings`` declares the same logical spec for the
+    block output, so the comms audit's GP405 check IS the partitioner
+    dry-run: if GSPMD stops honoring a declared rule (or the rules table
+    drifts from what lowering produces) the gate fails statically. Its
+    collective census (the mp all-reduces the sharded contractions
+    insert) is ratcheted like every mesh program's."""
+    from ..analysis.registry import AuditProgram
+    from ..models.transformer import TransformerBlock
+    import jax.numpy as jnp
+
+    need = int(np.prod(AUDIT_DPMP_MESH))
+    if len(jax.devices()) < need:
+        return {"dpmp_block": AuditProgram.skipped(
+            f"needs >= {need} devices (hint: XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={need})")}
+    mesh = make_dpmp_mesh()
+    m = ctx.cfg.model
+    dt = jnp.dtype(m.dtype)
+    b, t = 4, 8                         # tiny token grid, audit-scale
+    block = TransformerBlock(emb=m.emb, heads=m.heads,
+                             standard_heads=m.standard_heads, dtype=dt)
+    q0 = jnp.zeros((b, t, m.emb), dt)
+    k0 = jnp.zeros((b, t, m.emb), dt)
+    params = jax.eval_shape(lambda: block.init(
+        jax.random.PRNGKey(0), q0, k0))
+
+    logical = transformer_block_logical_axes(params)
+    shardings = jax.tree.map(
+        lambda ax: NamedSharding(mesh, logical_to_mesh_axes(ax)),
+        logical,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            isinstance(a, (str, type(None))) for a in x))
+    params_aval = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        params, shardings)
+    act = jax.ShapeDtypeStruct(
+        (b, t, m.emb), dt,
+        sharding=NamedSharding(
+            mesh, logical_to_mesh_axes(("batch", "tokens", "embed"))))
+
+    def apply(p, q, kk):
+        return block.apply(p, q, kk)
+    apply.__name__ = apply.__qualname__ = "_dpmp_block"
+    return {"dpmp_block": AuditProgram(
+        jax.jit(apply), (params_aval, act, act),
+        expected_output_shardings=act.sharding,
+        description=f"TransformerBlock under the fixed "
+                    f"{AUDIT_DPMP_MESH[0]}x{AUDIT_DPMP_MESH[1]} "
+                    f"(data, model) audit mesh, params stamped from "
+                    f"LOGICAL_AXIS_RULES — the ROADMAP item 3 dry-run "
+                    f"gate (GP405) plus its collective census")}
 
 
 def _pop_dp_twin(k, key):

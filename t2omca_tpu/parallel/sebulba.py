@@ -629,3 +629,40 @@ def register_audit_programs(ctx):
                         f"{n_learner}-device learner mesh "
                         f"(graftlattice)"),
     }
+
+
+def register_transfer_audits(ctx):
+    """graftshard registry hook (``analysis.registry.
+    collect_transfer_audits``): the ``params.sync`` publish as a static
+    src→dst sharding pair. ``publish_params`` is a cross-mesh
+    ``device_put`` — it never lowers to HLO, so the comms audit checks
+    the pair directly: agent params replicated on the learner mesh
+    (what ``learner_step`` outputs) against ``params_sharding()`` on
+    the actor mesh (what the publish requests). Every destination
+    shard is a full replica that exists verbatim on each learner
+    device, so the audit classifies the hop as a pure d2d copy — the
+    baseline entry in programs.json pins that, and a future dp×mp
+    learner mesh (ROADMAP item 3) that turns the publish into a
+    gather/reshard flips GP404 here before it ships."""
+    from ..analysis.registry import TransferAudit
+    n_actor, n_learner = AUDIT_SPLIT
+    need = n_actor + n_learner
+    if len(jax.devices()) < need:
+        return {"params_sync": TransferAudit.skipped(
+            f"needs >= {need} devices (hint: XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={need})")}
+    from .mesh import partition_devices
+    actor, learner = partition_devices(n_actor, n_learner)
+    seb = Sebulba.build(ctx.exp, actor, learner, queue_slots=2)
+    agent_shape = ctx.ts_shape.learner.params["agent"]
+    src_sh = seb._sh(seb.learner_mesh)      # replicated, learner mesh
+    src = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=src_sh),
+        agent_shape)
+    dst = jax.tree.map(lambda _: seb.params_sharding(), agent_shape)
+    return {"params_sync": TransferAudit(
+        src=src, dst_shardings=dst,
+        description=f"staleness-bounded learner→actor acting-params "
+                    f"publish (``Sebulba.publish_params``) under the "
+                    f"fixed {n_actor}+{n_learner} audit split — pinned "
+                    f"as a pure device-to-device copy")}

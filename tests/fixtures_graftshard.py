@@ -1,0 +1,117 @@
+"""Seeded comms-regression fixtures for the graftshard CLI acceptance
+test (``tests/test_graftshard.py`` — the ``fixtures_graftprog``
+pattern): four toy MESH programs, each tripping exactly ONE GP4xx rule
+when audited with ``--comms --program-module`` against the crafted
+baseline the test writes (exclusivity comes from the baseline: GP401/402
+are ratchets, so each fixture's baseline entry accepts everything except
+the one hazard it seeds). Never imported by the package.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from t2omca_tpu.parallel.mesh import make_mesh
+
+#: fixture mesh width — matches the smallest real audit mesh
+N_DEV = 2
+
+
+def _sharded(shape, mesh, spec, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype,
+                                sharding=NamedSharding(mesh, spec))
+
+
+def register_audit_programs(ctx):
+    from t2omca_tpu.analysis.registry import AuditProgram
+
+    if len(jax.devices()) < N_DEV:
+        skip = AuditProgram.skipped(f"needs >= {N_DEV} devices")
+        return {f"seeded_gp40{i}": skip for i in range(1, 5)}
+    mesh = make_mesh(N_DEV)
+    x = _sharded((8, 4), mesh, P("data"))
+
+    # GP401: a collective kind (the mean's all-reduce) the crafted
+    # baseline's empty census never accepted
+    def center(v):
+        return v - jnp.mean(v)
+    center.__name__ = center.__qualname__ = "_seeded_gp401"
+
+    # GP402: same collective, baselined kind-count generous but the
+    # bytes budget pinned to 1 with tolerance 0
+    def center2(v):
+        return v - jnp.mean(v)
+    center2.__name__ = center2.__qualname__ = "_seeded_gp402"
+
+    # GP403: forced replication of the full sharded input — the
+    # compiled program must all-gather the whole leaf (>= the largest
+    # sharded input's unsharded size) to satisfy the replicated output
+    def regather(v):
+        return v * jnp.float32(2.0)
+    regather.__name__ = regather.__qualname__ = "_seeded_gp403"
+    gather_jit = jax.jit(regather,
+                         out_shardings=NamedSharding(mesh, P()))
+
+    # GP404: the donated arg carries NO stamped sharding, so GSPMD
+    # propagates the sharded companion's layout onto its entry — the
+    # caller's (undeclared) buffer is resharded on dispatch and the
+    # donation frees the copy, not the original
+    def bump(w, v):
+        return w + v
+    bump.__name__ = bump.__qualname__ = "_seeded_gp404"
+    resharded_jit = jax.jit(bump, donate_argnums=(0,))
+
+    return {
+        "seeded_gp401": AuditProgram(
+            jax.jit(center), (x,),
+            description="unbaselined all-reduce (GP401 seed)"),
+        "seeded_gp402": AuditProgram(
+            jax.jit(center2), (x,),
+            description="collective bytes past a 1-byte budget "
+                        "(GP402 seed)"),
+        "seeded_gp403": AuditProgram(
+            gather_jit, (x,),
+            description="full-leaf all-gather via a forced replicated "
+                        "output (GP403 seed)"),
+        "seeded_gp404": AuditProgram(
+            resharded_jit,
+            (jax.ShapeDtypeStruct((8, 4), jnp.float32), x),
+            donate_argnums=(0,),
+            description="donated leaf unstamped, GSPMD shards its entry "
+                        "layout (GP404 seed)"),
+    }
+
+
+def crafted_baseline() -> dict:
+    """The programs.json payload the acceptance test writes: each entry
+    accepts everything EXCEPT its program's seeded hazard, so every
+    fixture fails with exactly one rule id."""
+    generous = {"count": 99, "bytes": 10 ** 9,
+                "axes": ["data"]}
+    just = "seeded-fixture baseline (tests/fixtures_graftshard.py)"
+    return {
+        "version": 1,
+        "platform": "cpu",
+        "programs": {
+            # empty census: ANY collective kind is unbaselined -> GP401
+            "seeded_gp401": {"comms": {
+                "collectives": {}, "bytes": 10 ** 9,
+                "tolerance": 0.0, "justification": just}},
+            # kinds accepted, bytes budget 1 with zero tolerance -> GP402
+            "seeded_gp402": {"comms": {
+                "collectives": {"all-reduce": dict(generous)},
+                "bytes": 1, "tolerance": 0.0, "justification": just}},
+            # kinds + bytes generous, GP403 count 0 -> GP403 only
+            "seeded_gp403": {"comms": {
+                "collectives": {"all-gather": dict(generous),
+                                "all-reduce": dict(generous)},
+                "bytes": 10 ** 9, "tolerance": 0.0,
+                "justification": just}},
+            # no collectives in an elementwise program; GP404 count 0
+            "seeded_gp404": {"comms": {
+                "collectives": {}, "bytes": 0,
+                "tolerance": 0.0, "justification": just}},
+        },
+    }

@@ -526,7 +526,9 @@ def test_registry_names_and_structure():
                         "actor_step", "learner_step",
                         "env_reset", "env_step",
                         "train_iter_sight", "superstep_sight",
-                        "superstep_pop"}
+                        "superstep_pop", "superstep_pop_pallas",
+                        "pop_dp_superstep", "pop_learner_step",
+                        "dpmp_block"}
     # the donated hot programs are the compiled (memory-audited) ones
     assert reg["superstep"].compile and reg["train_iter"].compile
     assert reg["superstep"].donate_argnums == (0,)

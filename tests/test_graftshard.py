@@ -1,0 +1,533 @@
+"""graftshard — the collective-traffic & sharding auditor
+(t2omca_tpu/analysis, docs/ANALYSIS.md §GP4xx): HLO census parsing and
+replica-group axis attribution, the comms/transfers ratchet semantics,
+the programs.json comms round-trip, in-process GP403/404/405 detection
+on toy mesh programs, the Sebulba params.sync d2d pin, the dp×mp
+logical-axis-rules table, and the CLI exit-code contract on the four
+seeded fixtures (tests/fixtures_graftshard.py)."""
+
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from t2omca_tpu.analysis import load_programs
+from t2omca_tpu.analysis.baseline import save_comms
+from t2omca_tpu.analysis.graftshard import (
+    COMMS_TOLERANCE, GP4_RULES, CommsReport, TransferReport,
+    audit_transfer, axis_label, census_bytes, compare_comms,
+    finish_comms_program, is_mesh_program, lower_comms_program,
+    parse_collectives, raw_findings)
+from t2omca_tpu.analysis.registry import (AuditProgram, TransferAudit,
+                                          collect_transfer_audits)
+from t2omca_tpu.parallel.mesh import (LOGICAL_AXIS_RULES,
+                                      logical_to_mesh_axes, make_mesh,
+                                      transformer_block_logical_axes)
+
+pytestmark = [pytest.mark.analysis, pytest.mark.comms]
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = Path(__file__).resolve().parent / "fixtures_graftshard.py"
+PROGRAMS_JSON = REPO / "t2omca_tpu" / "analysis" / "programs.json"
+
+
+def _cli(*args, timeout=240, env=None):
+    import os
+    e = None
+    if env is not None:
+        e = dict(os.environ)
+        e.update(env)
+    return subprocess.run(
+        [sys.executable, "-m", "t2omca_tpu.analysis", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout,
+        env=e)
+
+
+def _load_fixtures():
+    spec = importlib.util.spec_from_file_location(
+        "fixtures_graftshard", FIXTURES)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------------- HLO census parsing
+
+SYNTH_HLO = """\
+  %p0 = f32[8,4]{1,0} parameter(0)
+  %ar = f32[8,4]{1,0} all-reduce(f32[8,4]{1,0} %p0), replica_groups={{0,1},{2,3}}, to_apply=%sum
+  %ag = (f32[16,4]{1,0}, f32[16,4]{1,0}) all-gather(f32[8,4]{1,0} %a, f32[8,4]{1,0} %b), replica_groups=[2,2]<=[4], dimensions={0}
+  %ags = f32[8]{0} all-gather-start(f32[4]{0} %c), replica_groups=[2,2]<=[2,2]T(1,0), dimensions={0}
+  %agd = f32[8]{0} all-gather-done(f32[8]{0} %ags)
+  %cp = f32[4]{0} collective-permute(f32[4]{0} %x), source_target_pairs={{0,2},{1,3}}
+  %add = f32[8,4]{1,0} add(f32[8,4]{1,0} %p0, f32[8,4]{1,0} %p0)
+"""
+
+
+def test_parse_collectives_synthetic_census():
+    census = parse_collectives(SYNTH_HLO, (2, 2), ("data", "model"))
+    # the -done half of the async pair is skipped, its -start counted
+    assert census["all-gather"]["count"] == 2
+    assert census["all-gather"]["bytes"] == 2 * 16 * 4 * 4 + 8 * 4
+    assert census["all-gather"]["axes"] == ["data", "model"]
+    # explicit {{0,1},{2,3}} groups on a 2x2 mesh = the minor axis
+    assert census["all-reduce"] == {
+        "count": 1, "bytes": 8 * 4 * 4, "axes": ["model"]}
+    # permute pairs (0,2)/(1,3) differ along the major axis only
+    assert census["collective-permute"] == {
+        "count": 1, "bytes": 4 * 4, "axes": ["data"]}
+    assert census_bytes(census) == (8 * 4 * 4) + (2 * 16 * 4 * 4 + 32) \
+        + 4 * 4
+
+
+def test_parse_collectives_dtype_sizes_and_full_mesh_label():
+    text = ("  %r = bf16[8]{0} all-reduce(bf16[8]{0} %x), "
+            "replica_groups={{0,1,2,3}}, to_apply=%s\n")
+    census = parse_collectives(text, (2, 2), ("data", "model"))
+    assert census["all-reduce"]["bytes"] == 8 * 2
+    # one group spanning the whole mesh is attributed to both axes
+    assert census["all-reduce"]["axes"] == ["data+model"]
+
+
+def test_axis_label_attribution():
+    assert axis_label([[0, 2], [1, 3]], (2, 2), ("data", "model")) == \
+        "data"
+    assert axis_label([[0, 1], [2, 3]], (2, 2), ("data", "model")) == \
+        "model"
+    assert axis_label([[0, 1, 2, 3]], (4,), ("data",)) == "data"
+    # groups matching no single axis pattern are mixed, not misattributed
+    assert axis_label([[0, 3], [1, 2]], (2, 2), ("data", "model")) == \
+        "mixed"
+    assert axis_label(None, (2, 2), ("data", "model")) == "?"
+
+
+# ----------------------------------------------------- ratchet semantics
+
+def _rep(name="prog", census=None, total=0, rules=None):
+    return CommsReport(name=name, census=census or {},
+                       total_bytes=total, mesh="2 (data)",
+                       rule_details=rules or {})
+
+
+def _base(census=None, nbytes=0, tol=0.1, rules=None, extra=None):
+    comms = {"collectives": census or {}, "bytes": nbytes,
+             "tolerance": tol, "justification": "test"}
+    if rules:
+        comms["rules"] = rules
+    entry = {"comms": comms}
+    if extra:
+        entry.update(extra)
+    return {"platform": "cpu", "programs": {"prog": entry},
+            "transfers": {}}
+
+
+def _rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def test_gp401_no_comms_baseline_flags_every_kind():
+    rep = _rep(census={"all-reduce": {"count": 1, "bytes": 4,
+                                      "axes": ["data"]},
+                       "all-gather": {"count": 2, "bytes": 8,
+                                      "axes": ["data"]}},
+               total=12, rules={"GP403": ["blowup"]})
+    findings, _ = compare_comms(
+        [rep], [], {"platform": "cpu", "programs": {}, "transfers": {}})
+    assert sorted(_rules_of(findings)) == ["GP401", "GP401", "GP403"]
+
+
+def test_gp401_count_ratchet_and_stale_shrink():
+    base = _base(census={"all-reduce": {"count": 2, "bytes": 8,
+                                        "axes": ["data"]}}, nbytes=8)
+    grown = _rep(census={"all-reduce": {"count": 3, "bytes": 8,
+                                        "axes": ["data"]}}, total=8)
+    findings, stale = compare_comms([grown], [], base)
+    assert _rules_of(findings) == ["GP401"]
+    shrunk = _rep(census={"all-reduce": {"count": 1, "bytes": 8,
+                                         "axes": ["data"]}}, total=8)
+    findings, stale = compare_comms([shrunk], [], base)
+    assert findings == [] and any("count dropped" in s for s in stale)
+    gone = _rep(census={}, total=0)
+    findings, stale = compare_comms([gone], [], base)
+    assert findings == [] and any("no longer present" in s
+                                  for s in stale)
+
+
+def test_gp402_tolerance_boundaries():
+    base = _base(census={"all-reduce": {"count": 1, "bytes": 100,
+                                        "axes": ["data"]}},
+                 nbytes=100, tol=0.1)
+    c = {"all-reduce": {"count": 1, "bytes": 110, "axes": ["data"]}}
+    ok, _ = compare_comms([_rep(census=c, total=110)], [], base)
+    assert ok == []                      # exactly at +10%: inside
+    over, _ = compare_comms([_rep(census=c, total=111)], [], base)
+    assert _rules_of(over) == ["GP402"]
+    _, stale = compare_comms([_rep(census=c, total=89)], [], base)
+    assert any("bytes improved" in s for s in stale)
+
+
+def test_gp402_kinds_baselined_without_byte_budget():
+    base = _base(census={"all-reduce": {"count": 1, "bytes": 4,
+                                        "axes": ["data"]}}, nbytes=0)
+    findings, _ = compare_comms(
+        [_rep(census={"all-reduce": {"count": 1, "bytes": 4,
+                                     "axes": ["data"]}}, total=4)],
+        [], base)
+    assert _rules_of(findings) == ["GP402"]
+
+
+def test_structural_rule_ratchet_counts():
+    base = _base(rules={"GP403": {"count": 1, "justification": "t"}})
+    at = _rep(rules={"GP403": ["one"]})
+    findings, stale = compare_comms([at], [], base)
+    assert findings == [] and stale == []
+    # one extra occurrence: the excess detail plus the count summary
+    over = _rep(rules={"GP403": ["one", "two"]})
+    findings, _ = compare_comms([over], [], base)
+    assert _rules_of(findings) == ["GP403", "GP403"]
+    fixed = _rep()
+    findings, stale = compare_comms([fixed], [], base)
+    assert findings == [] and any("GP403 count dropped" in s
+                                  for s in stale)
+
+
+def test_vanished_entries_and_skips_go_stale_not_fail():
+    base = _base()
+    findings, stale = compare_comms([], [], base)
+    assert findings == [] and any("no longer audited" in s
+                                  for s in stale)
+    skip = CommsReport(name="prog", skipped="needs 4 devices")
+    findings, stale = compare_comms([skip], [], base)
+    assert findings == [] and any("skipped" in s for s in stale)
+
+
+def test_transfer_ratchet_semantics():
+    empty = {"platform": "cpu", "programs": {}, "transfers": {}}
+    rep = TransferReport(name="sync", leaves=2, bytes=64,
+                         kind="d2d-copy")
+    findings, _ = compare_comms([], [rep], empty)
+    assert _rules_of(findings) == ["GP401"]      # unbaselined transfer
+    base = {"platform": "cpu", "programs": {},
+            "transfers": {"sync": {"leaves": 2, "bytes": 64,
+                                   "kind": "d2d-copy",
+                                   "tolerance": 0.1,
+                                   "justification": "t"}}}
+    findings, stale = compare_comms([], [rep], base)
+    assert findings == [] and stale == []
+    degraded = TransferReport(name="sync", leaves=2, bytes=64,
+                              kind="reshard",
+                              rule_details={"GP404": ["leaf moved"]})
+    findings, _ = compare_comms([], [degraded], base)
+    assert sorted(_rules_of(findings)) == ["GP401", "GP404", "GP404"]
+    fat = TransferReport(name="sync", leaves=2, bytes=256,
+                         kind="d2d-copy")
+    findings, _ = compare_comms([], [fat], base)
+    assert _rules_of(findings) == ["GP402"]
+    _, stale = compare_comms([], [], base)
+    assert any("no longer registered" in s for s in stale)
+
+
+def test_raw_findings_structural_only():
+    rep = _rep(census={"all-reduce": {"count": 9, "bytes": 999,
+                                      "axes": ["data"]}},
+               total=999, rules={"GP404": ["boundary"]})
+    tr = TransferReport(name="sync", kind="reshard",
+                        rule_details={"GP404": ["leaf"]})
+    out = raw_findings([rep], [tr])
+    # GP401/402 are ratchets: without a baseline only GP403/404/405
+    assert sorted(_rules_of(out)) == ["GP404", "GP404"]
+
+
+# ------------------------------------------------ programs.json comms IO
+
+def test_save_comms_round_trip_preserves_justifications(tmp_path):
+    path = tmp_path / "programs.json"
+    rep = _rep(census={"all-reduce": {"count": 2, "bytes": 64,
+                                      "axes": ["data"]}},
+               total=64, rules={"GP403": ["blowup"]})
+    tr = TransferReport(name="sync", leaves=3, bytes=12,
+                        kind="d2d-copy")
+    save_comms(path, [rep], [tr], platform="cpu", old={})
+    base = load_programs(path)
+    comms = base["programs"]["prog"]["comms"]
+    assert comms["collectives"]["all-reduce"]["count"] == 2
+    assert comms["tolerance"] == COMMS_TOLERANCE
+    assert comms["justification"].startswith("TODO")
+    assert comms["rules"]["GP403"]["count"] == 1
+    assert base["transfers"]["sync"]["kind"] == "d2d-copy"
+
+    data = json.loads(path.read_text())
+    data["programs"]["prog"]["comms"]["justification"] = "accepted"
+    data["programs"]["prog"]["comms"]["tolerance"] = 0.02
+    data["programs"]["prog"]["comms"]["rules"]["GP403"][
+        "justification"] = "known gather"
+    data["transfers"]["sync"]["justification"] = "pure publish"
+    path.write_text(json.dumps(data))
+    save_comms(path, [rep], [tr], platform="cpu",
+               old=load_programs(path))
+    base = load_programs(path)
+    comms = base["programs"]["prog"]["comms"]
+    assert comms["justification"] == "accepted"
+    assert comms["tolerance"] == 0.02
+    assert comms["rules"]["GP403"]["justification"] == "known gather"
+    assert base["transfers"]["sync"]["justification"] == "pure publish"
+
+
+def test_save_comms_keeps_program_sections_and_skips(tmp_path):
+    path = tmp_path / "programs.json"
+    old = {"platform": "cpu", "transfers": {},
+           "programs": {"prog": {"fingerprint": "abc123",
+                                 "comms": {"collectives": {},
+                                           "bytes": 7,
+                                           "tolerance": 0.1,
+                                           "justification": "old"}}}}
+    # a skipped audit must leave the previous section untouched
+    save_comms(path, [CommsReport(name="prog", skipped="no devices")],
+               [], platform="cpu", old=old)
+    base = load_programs(path)
+    assert base["programs"]["prog"]["fingerprint"] == "abc123"
+    assert base["programs"]["prog"]["comms"]["bytes"] == 7
+    assert base["programs"]["prog"]["comms"]["justification"] == "old"
+
+
+def test_checked_in_comms_baseline_is_justified():
+    """The ISSUE acceptance gate: every comms/transfers entry in the
+    checked-in baseline carries a real justification (no TODO), the
+    population learner pins ZERO cross-member collectives, and the
+    dp×mp twin carries its model-axis contraction all-reduce."""
+    base = json.loads(PROGRAMS_JSON.read_text())
+    comms = {n: e["comms"] for n, e in base["programs"].items()
+             if "comms" in e}
+    assert set(comms) >= {"dp_superstep", "actor_step", "learner_step",
+                          "pop_dp_superstep", "pop_learner_step",
+                          "dpmp_block"}
+    for name, c in comms.items():
+        assert c["justification"] and "TODO" not in c["justification"], \
+            name
+        assert 0.0 <= c["tolerance"] <= 0.5, name
+        for rule, r in c.get("rules", {}).items():
+            assert rule in GP4_RULES, (name, rule)
+            assert "TODO" not in r["justification"], (name, rule)
+    assert comms["pop_learner_step"]["collectives"] == {}
+    assert "model" in \
+        comms["dpmp_block"]["collectives"]["all-reduce"]["axes"]
+    sync = base["transfers"]["params_sync"]
+    assert sync["kind"] == "d2d-copy"
+    assert "TODO" not in sync["justification"]
+
+
+# ------------------------------------------- in-process rule detection
+
+def _finish(name, prog):
+    rep, lowered = lower_comms_program(name, prog)
+    return finish_comms_program(rep, prog, lowered.compile())
+
+
+def _sds(shape, mesh, spec):
+    return jax.ShapeDtypeStruct(shape, jnp.float32,
+                                sharding=NamedSharding(mesh, spec))
+
+
+def test_is_mesh_program_selection():
+    mesh = make_mesh(2)
+    stamped = AuditProgram(jax.jit(lambda x: x),
+                           (_sds((8,), mesh, P("data")),))
+    plain = AuditProgram(jax.jit(lambda x: x),
+                         (jax.ShapeDtypeStruct((8,), jnp.float32),))
+    assert is_mesh_program(stamped) and not is_mesh_program(plain)
+    assert is_mesh_program(AuditProgram.skipped("small host"))
+
+
+def test_gp403_full_gather_detected_in_process():
+    mesh = make_mesh(2)
+    prog = AuditProgram(
+        jax.jit(lambda v: v * 2.0,
+                out_shardings=NamedSharding(mesh, P())),
+        (_sds((8, 4), mesh, P("data")),))
+    rep = _finish("regather", prog)
+    assert rep.rule_count("GP403") == 1
+    assert "all-gather materializes" in rep.rule_details["GP403"][0]
+
+
+def test_gp404_unstamped_donated_leaf_detected_in_process():
+    mesh = make_mesh(2)
+    prog = AuditProgram(
+        jax.jit(lambda w, v: w + v, donate_argnums=(0,)),
+        (jax.ShapeDtypeStruct((8, 4), jnp.float32),
+         _sds((8, 4), mesh, P("data"))),
+        donate_argnums=(0,))
+    rep = _finish("bump", prog)
+    assert rep.rule_count("GP404") == 1
+    assert "defeats donation" in rep.rule_details["GP404"][0]
+
+
+def test_gp404_negative_stamped_donation_is_clean():
+    mesh = make_mesh(2)
+    prog = AuditProgram(
+        jax.jit(lambda w, v: w + v, donate_argnums=(0,)),
+        (_sds((8, 4), mesh, P("data")), _sds((8, 4), mesh, P("data"))),
+        donate_argnums=(0,))
+    assert _finish("bump", prog).rule_count("GP404") == 0
+
+
+def test_gp405_declared_output_sharding_violation():
+    mesh = make_mesh(2)
+    prog = AuditProgram(
+        jax.jit(lambda v: v * 1.0,
+                out_shardings=NamedSharding(mesh, P())),
+        (_sds((8, 4), mesh, P("data")),),
+        expected_output_shardings=NamedSharding(mesh, P("data")))
+    rep = _finish("declared", prog)
+    assert rep.rule_count("GP405") == 1
+    honored = AuditProgram(
+        jax.jit(lambda v: v * 1.0),
+        (_sds((8, 4), mesh, P("data")),),
+        expected_output_shardings=NamedSharding(mesh, P("data")))
+    assert _finish("declared", honored).rule_count("GP405") == 0
+
+
+# ----------------------------------------------------- transfer audits
+
+def test_audit_transfer_classifies_local_copy_reshard():
+    devs = jax.devices()
+    learner = Mesh(devs[:2], ("data",))
+    actor = Mesh(devs[2:4], ("data",))
+
+    def one(src_spec, dst_mesh, dst_spec):
+        src = _sds((4, 4), learner, src_spec)
+        return audit_transfer("t", TransferAudit(
+            src=(src,), dst_shardings=(NamedSharding(dst_mesh,
+                                                     dst_spec),),
+            description="test"))
+
+    same = one(P(), learner, P())
+    assert same.kind == "local" and same.bytes == 0
+    copied = one(P(), actor, P())
+    assert copied.kind == "d2d-copy"
+    assert copied.bytes == 2 * 4 * 4 * 4      # full leaf to 2 new devs
+    assert copied.rule_details == {}
+    degraded = one(P("data"), actor, P())
+    assert degraded.kind == "reshard"
+    assert degraded.rule_count("GP404") == 1
+    skipped = audit_transfer("t", TransferAudit.skipped("small host"))
+    assert skipped.skipped == "small host"
+
+
+def test_params_sync_publish_is_pure_d2d_copy():
+    """Satellite pin: the Sebulba 2+2 params.sync publish must audit as
+    a pure device-to-device copy — replicated learner params land
+    verbatim on the actor mesh, never via a gather/reshard."""
+    audits = collect_transfer_audits()
+    assert "params_sync" in audits
+    rep = audit_transfer("params_sync", audits["params_sync"])
+    assert rep.skipped is None
+    assert rep.kind == "d2d-copy"
+    assert rep.rule_details == {}
+    assert rep.leaves > 0 and rep.bytes > 0
+
+
+# ----------------------------------------------- logical axis rules
+
+def test_logical_to_mesh_axes_mapping():
+    assert logical_to_mesh_axes(("batch", None, "heads")) == \
+        P("data", None, "model")
+    assert logical_to_mesh_axes(("embed", "joined_kv")) == \
+        P(None, "model")
+    assert logical_to_mesh_axes(("mlp",)) == P("model")
+    with pytest.raises(ValueError, match="no LOGICAL_AXIS_RULES entry"):
+        logical_to_mesh_axes(("batch", "vocab"))
+    # replicated-by-rule axes map to None, not to a silent drop
+    assert tuple(dict(LOGICAL_AXIS_RULES)[n] for n in
+                 ("embed", "tokens", "kv")) == (None, None, None)
+
+
+def test_transformer_block_logical_axes_table():
+    leaf = object()
+    params = {"params": {
+        "tokeys": {"kernel": leaf}, "toqueries": {"kernel": leaf},
+        "tovalues": {"kernel": leaf},
+        "unifyheads": {"kernel": leaf, "bias": leaf},
+        "ff1": {"kernel": leaf, "bias": leaf},
+        "ff2": {"kernel": leaf, "bias": leaf},
+        "norm1": {"scale": leaf, "bias": leaf},
+        "norm2": {"scale": leaf, "bias": leaf},
+    }}
+    axes = transformer_block_logical_axes(params)["params"]
+    assert axes["tokeys"]["kernel"] == ("embed", "joined_kv")
+    assert axes["unifyheads"]["kernel"] == ("joined_kv", "embed")
+    assert axes["unifyheads"]["bias"] == ("embed",)
+    assert axes["ff1"]["kernel"] == ("embed", "mlp")
+    assert axes["ff1"]["bias"] == ("mlp",)
+    assert axes["ff2"]["kernel"] == ("mlp", "embed")
+    assert axes["norm1"]["scale"] == ("embed",)
+    with pytest.raises(ValueError, match="no logical-axes mapping"):
+        transformer_block_logical_axes(
+            {"params": {"mystery": {"kernel": leaf}}})
+
+
+def test_obs_report_comms_census_section():
+    """The report's static interconnect section renders straight off
+    the checked-in baseline — no jax, nothing compiled."""
+    from t2omca_tpu.obs.report import render_comms_census
+    base = json.loads(PROGRAMS_JSON.read_text())
+    lines = render_comms_census(base)
+    text = "\n".join(lines)
+    assert "collective census" in text
+    assert "dp_superstep" in text and "dpmp_block" in text
+    assert "params_sync" in text and "d2d-copy" in text
+    # a baseline with no comms sections keeps the report unchanged
+    assert render_comms_census({"programs": {}, "transfers": {}}) == []
+
+
+# ----------------------------------------------------------------- CLI
+
+def test_cli_seeded_comms_regressions_flip_exit_1(tmp_path):
+    """The ISSUE acceptance gate: each planted comms hazard flips the
+    CLI to exit 1 with the matching GP4xx rule id — and ONLY that rule
+    (one subprocess for all four; the crafted baseline accepts
+    everything except each fixture's seeded hazard)."""
+    fixtures = _load_fixtures()
+    baseline = tmp_path / "programs.json"
+    baseline.write_text(json.dumps(fixtures.crafted_baseline()))
+    r = _cli("--comms", "--program-module", str(FIXTURES),
+             "--programs-baseline", str(baseline),
+             "--only", "seeded_gp401", "--only", "seeded_gp402",
+             "--only", "seeded_gp403", "--only", "seeded_gp404")
+    assert r.returncode == 1, r.stderr
+    expected = [("seeded_gp401", "GP401"), ("seeded_gp402", "GP402"),
+                ("seeded_gp403", "GP403"), ("seeded_gp404", "GP404")]
+    for prog, rule in expected:
+        assert f"{prog}: {rule}" in r.stdout, (rule, r.stdout)
+        for other in GP4_RULES:
+            if other != rule:
+                assert f"{prog}: {other}" not in r.stdout, \
+                    (prog, other, r.stdout)
+
+
+def test_cli_write_programs_refuses_only():
+    r = _cli("--comms", "--write-programs", "--only", "seeded_gp401",
+             timeout=60)
+    assert r.returncode == 2
+    assert "cannot be combined with --only" in r.stderr
+
+
+@pytest.mark.slow
+def test_cli_write_programs_refuses_small_host(tmp_path):
+    """Satellite pin: a baseline rewrite on a host exposing fewer
+    devices than the largest registered audit mesh must refuse (exit 2)
+    instead of silently carrying stale sections for the skipped
+    4-device programs."""
+    baseline = tmp_path / "programs.json"
+    r = _cli("--comms", "--write-programs",
+             "--programs-baseline", str(baseline),
+             env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                  "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 2, (r.stdout, r.stderr)
+    assert "4 host devices, have 2" in r.stderr
+    assert not baseline.exists()
