@@ -13,6 +13,14 @@
 # fleet — the quarantined engines restarted, rejoined, and serving a
 # fresh request.
 #
+# The graftmorph elastic scenarios (tests/test_elastic.py,
+# docs/RESILIENCE.md §6) cycle too: a failed preemption barrier must
+# degrade to the per-host shard save and resume elastically — the
+# coordinated-preemption exit path soaks alongside the dispatch
+# faults it shares machinery with. The multi-host leg (chaos-marked in
+# tests/test_multihost.py) SIGKILLs one of two real gloo processes and
+# asserts the survivor exits 0 with a resumable checkpoint.
+#
 # Usage: bash scripts/chaos.sh [N]      (default N=3)
 #
 # Slow by design (each driver scenario is a full run() with fresh
@@ -25,6 +33,7 @@ cd "$(dirname "$0")/.." || exit 2
 for i in $(seq 1 "$N"); do
   echo "== chaos cycle $i/$N =="
   JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_fleet.py \
+    tests/test_elastic.py tests/test_multihost.py \
     -m chaos -q -p no:cacheprovider -p no:randomly || {
       echo "chaos cycle $i/$N FAILED — a fault scenario left the run "
       echo "unresumable (see the assertion above; docs/RESILIENCE.md §5)"

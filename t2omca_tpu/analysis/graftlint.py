@@ -54,6 +54,17 @@ GL111     Bare ``lock.acquire()`` without ``timeout=`` (or
           obs/): a stuck holder wedges the thread with no watchdog
           escape — the PR 4 save_lock class. ``with lock:`` is exempt
           (the idiom for short critical sections).
+GL112     Raw ``flax.serialization.msgpack_restore`` /
+          ``from_state_dict`` in a driver/serve module
+          (``CKPT_PATH_GLOBS``): checkpoint bytes must enter through
+          ``utils/checkpoint.py``'s verify path (checksum gate, format
+          migration, shard assembly, elastic routing) — a raw
+          deserialize dodges all four and resurrects the torn-read and
+          stale-format classes the checkpoint layer exists to kill.
+          The two standing serve-layer loads (an exported artifact
+          blob with its own recorded sha256, and a template restore
+          already downstream of ``restore_host_state``) are baselined
+          with justifications, not exempted by rule.
 ========  ==============================================================
 
 Scope and honesty about limits: "traced code" means functions that are
@@ -95,6 +106,8 @@ RULES: Dict[str, str] = {
     "GL110": "device-boundary wrapper phase missing from obs span registry",
     "GL111": "bare lock acquire() without timeout in a liveness-critical "
              "module",
+    "GL112": "raw checkpoint deserialize outside utils/checkpoint's "
+             "verify path",
 }
 
 #: driver helper names whose first argument is a span/watchdog phase
@@ -133,6 +146,25 @@ LOCK_PATH_GLOBS: Tuple[str, ...] = (
     "t2omca_tpu/utils/watchdog.py",
     "t2omca_tpu/obs/*.py",
 )
+
+#: modules where a RAW flax deserialize of checkpoint bytes is a
+#: correctness hazard (GL112): the driver and the serving layer consume
+#: checkpoints, and ``utils/checkpoint.py`` is the one sanctioned door —
+#: its restore path owns the sha256 gate against torn/truncated writes,
+#: the v3→v5 format migration chain, partial-save shard assembly and
+#: the elastic topology routing (docs/RESILIENCE.md §6). A call that
+#: goes straight to ``flax.serialization`` silently skips all of them.
+#: utils/checkpoint.py itself is deliberately NOT listed.
+CKPT_PATH_GLOBS: Tuple[str, ...] = (
+    "t2omca_tpu/run.py",
+    "t2omca_tpu/serve/*.py",
+)
+
+#: the flax deserializers GL112 polices (alias-resolved dotted names)
+_RAW_CKPT_LOADS = frozenset({
+    "flax.serialization.msgpack_restore",
+    "flax.serialization.from_state_dict",
+})
 
 # tracing entry points: wrapping one of these around a function makes its
 # body traced code. Canonical (alias-resolved) dotted names.
@@ -680,6 +712,37 @@ class _ModuleLinter:
                       "the False return, use `blocking=False`, or "
                       "baseline with a justification")
 
+    def _check_raw_ckpt_loads(self) -> None:
+        """GL112: a raw ``flax.serialization.msgpack_restore`` /
+        ``from_state_dict`` call in a checkpoint-consuming module
+        (``CKPT_PATH_GLOBS``). Name-based on the alias-resolved dotted
+        path, with an attribute fallback for handles the alias map
+        cannot see (``flax.serialization as ser``-style chains resolve;
+        a bound method stored in a variable does not, and none exist in
+        the repo today). Justified standing loads live in the baseline,
+        not in a rule exemption — a NEW raw load must argue its case."""
+        if not any(fnmatch.fnmatch(self.path, g)
+                   for g in CKPT_PATH_GLOBS):
+            return
+        tails = {name.rsplit(".", 1)[1] for name in _RAW_CKPT_LOADS}
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self.canonical(node.func)
+            hit = name in _RAW_CKPT_LOADS or (
+                name is None and isinstance(node.func, ast.Attribute)
+                and node.func.attr in tails)
+            if hit:
+                what = name or node.func.attr
+                self.emit(node, "GL112",
+                          f"raw `{what}` deserializes checkpoint bytes "
+                          f"outside utils/checkpoint.py's verify path — "
+                          f"no checksum gate, no format migration, no "
+                          f"shard assembly, no elastic routing; load "
+                          f"through utils/checkpoint (or baseline with "
+                          f"a justification for why this surface is "
+                          f"already downstream of it)")
+
     def _check_donation_alias(self) -> None:
         for fns in self.defs.values():
             for fn in fns:
@@ -797,6 +860,7 @@ class _ModuleLinter:
             self._check_closure_consts(fn, traced_ids)
         self._check_hot_path()
         self._check_bare_acquire()
+        self._check_raw_ckpt_loads()
         self._check_donation_alias()
         self._check_dead_imports()
         self._check_span_phases()

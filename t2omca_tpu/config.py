@@ -251,6 +251,12 @@ class ResilienceConfig:
     # back to K=1 (smaller blast radius — each dispatch then risks one
     # iteration, not K) before restoring a checkpoint
     degrade_superstep: bool = True
+    # coordinated multi-host preemption (docs/RESILIENCE.md §6): how long
+    # the signaled hosts wait at the stop-step barrier for their peers
+    # before degrading to the per-host shard save. Bounds the exit path
+    # against a peer that died mid-preemption; single-host runs never
+    # wait.
+    preempt_barrier_timeout_s: float = 10.0
 
 
 @dataclass(frozen=True)
@@ -711,6 +717,12 @@ def sanity_check(cfg: TrainConfig) -> TrainConfig:
             f"resilience.dispatch_retries/retry_backoff_s must be >= 0, "
             f"got dispatch_retries={res.dispatch_retries}, "
             f"retry_backoff_s={res.retry_backoff_s}")
+    if res.preempt_barrier_timeout_s <= 0:
+        raise ValueError(
+            f"resilience.preempt_barrier_timeout_s must be > 0 (it bounds "
+            f"the coordinated-preemption peer barrier against dead peers; "
+            f"an unbounded wait would hang the exit path forever), got "
+            f"{res.preempt_barrier_timeout_s}")
     if res.inject_nan_at_step >= 0 and res.nonfinite_tolerance == 0:
         raise ValueError(
             "resilience.inject_nan_at_step is a fault-injection knob whose "

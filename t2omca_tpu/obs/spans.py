@@ -85,8 +85,15 @@ KNOWN_PHASES = frozenset({
     # learner→actor parameter publish/adopt hop
     "actor.dispatch", "queue.put", "queue.get", "learner.dispatch",
     "params.sync",
-    # checkpoint + startup boundaries
+    # checkpoint + startup boundaries. graftmorph (docs/RESILIENCE.md
+    # §6) adds the elastic-resume routing boundary (checkpoint.elastic:
+    # host read + topology reshape before placement), the coordinated-
+    # preemption peer barrier (preempt.barrier: bounded KV-store
+    # rendezvous agreeing on the cut step), and the degraded per-host
+    # shard write (checkpoint.shard_save: the collective-free fallback
+    # when a peer died mid-preemption)
     "checkpoint.save", "collective.gather", "backend.init",
+    "checkpoint.elastic", "preempt.barrier", "checkpoint.shard_save",
     # bench.py phases (bench harness spans; embedded in BENCH_r*.json).
     # bench.probe is the RETRYABLE backend-init phase (per-attempt
     # budget split + backoff ladder); bench.probe.fallback is the

@@ -41,10 +41,11 @@ from .obs import memwatch as obs_memwatch
 from .obs import pulse as obs_pulse
 from .obs import sight as obs_sight
 from .obs import spans as obs_spans
-from .utils import resilience, watchdog
+from .parallel import distributed as dist
+from .utils import elastic, resilience, watchdog
 from .utils.checkpoint import (find_checkpoint, load_checkpoint,
                                load_checkpoint_sharded, prune_checkpoints,
-                               save_checkpoint)
+                               save_checkpoint, save_checkpoint_shards)
 from .utils.logging import Logger
 from .utils.profiling import StageTimer, TraceWindow
 from .utils.stats import StatsAccumulator
@@ -1039,14 +1040,18 @@ def run_sequential(exp: Experiment, logger: Logger,
         # the replay ring at startup, an OOM at config-5 ring sizes
         ts = dp.init_sharded(cfg.seed)
     elif dp is not None:
-        # DP resume: restore each leaf straight onto the mesh
-        # (load_checkpoint_sharded) — the classic init → load → shard
-        # sequence re-creates the same single-device ring transient the
-        # born-sharded init exists to avoid (ADVICE r5)
+        # DP resume: restore each leaf straight onto the mesh — the
+        # classic init → load → shard sequence re-creates the same
+        # single-device ring transient the born-sharded init exists to
+        # avoid (ADVICE r5). elastic.resume_state keeps the rigid
+        # load_checkpoint_sharded path when the topology stamp matches
+        # and routes population/topology changes through restore_elastic
+        # (docs/RESILIENCE.md §6).
         shapes = jax.eval_shape(lambda: exp.init_train_state(cfg.seed))
-        ts = load_checkpoint_sharded(found[0], shapes,
+        ts, _ = elastic.resume_state(found[0], shapes,
                                      dp.state_shardings(shapes),
-                                     verify=False)
+                                     verify=False,
+                                     topology={"loop": "classic"})
     elif P and found is None:
         # population init: P explicit solo inits stacked — member i's
         # leaves are bit-identical to a solo init at seed_i
@@ -1111,13 +1116,18 @@ def run_sequential(exp: Experiment, logger: Logger,
         if P:
             # population resume: the checkpoint is a PopState (or a
             # v4 single-member state the migration shim lifts to
-            # P=stacked — utils/checkpoint._migrate_raw)
-            ps = load_checkpoint(dirname, _ckpt_state(), verify=False)
+            # P=stacked — utils/checkpoint._migrate_raw). A stamped
+            # P-mismatch (grow/shrink since the save) routes through
+            # restore_elastic via elastic.resume_state.
+            ps, _ = elastic.resume_state(dirname, _ckpt_state(),
+                                         verify=False,
+                                         topology={"loop": "classic"})
             ts, spec = ps.ts, ps.spec
         elif dp is None:
             # find_checkpoint already hashed this candidate — skip
             # re-verify (the DP path restored sharded above)
-            ts = load_checkpoint(dirname, ts, verify=False)
+            ts, _ = elastic.resume_state(dirname, ts, verify=False,
+                                         topology={"loop": "classic"})
         t_env = step
         new_t = (jnp.full((P,), step, jnp.int32) if P
                  else jnp.asarray(step, jnp.int32))
@@ -1154,6 +1164,27 @@ def run_sequential(exp: Experiment, logger: Logger,
     nonfinite_streak = 0            # consecutive tripped train steps
     nonfinite_total = 0
     restores = 0                    # guard-triggered checkpoint restores
+    # coordinated preemption (docs/RESILIENCE.md §6): once the guard
+    # trips, every host negotiates ONE cut step (stop_at); stop_ok=False
+    # means a peer died mid-negotiation and the exit path must degrade
+    # to the per-host shard save (no collectives over a corpse)
+    stop_at = None
+    stop_ok = True
+
+    def _save_topology():
+        """The topology stamp every save carries (meta.json) — what a
+        later resume compares its own shape against. The member ranking
+        (best first, from the host-side EMA returns when every member
+        has one) is what an elastic population SHRINK keeps."""
+        topo = {"loop": "classic"}
+        if dp is not None or pop_mesh is not None:
+            topo["mesh_shape"] = [int(cfg.dp_devices)]
+        if P:
+            ema = getattr(train_acc, "member_return_ema", None)
+            if ema and all(v is not None for v in ema):
+                topo["member_ranking"] = sorted(
+                    range(P), key=lambda m: ema[m], reverse=True)
+        return topo
 
     # ---- hang detection + degradation ladder (RESILIENCE.md §5) --------
     # The watchdog's stall callback runs in the WATCHDOG thread — the main
@@ -1446,8 +1477,22 @@ def run_sequential(exp: Experiment, logger: Logger,
             # dispatches, so a preemption loses at most K iterations and a
             # restored checkpoint always resumes at a K-aligned t_env
             resilience.fire("driver.iteration", t_env=t_env, guard=guard)
+            # coordinated preemption (docs/RESILIENCE.md §6): propagate a
+            # PEER's announced shutdown into the local guard, then
+            # negotiate the one cut step all hosts share. Hosts behind
+            # the consensus keep stepping (lockstep dp trajectories make
+            # every host's t_env reach stop_at) so the collective
+            # emergency save below runs at one t_env on every host.
+            if not guard.triggered and dist.peer_shutdown_requested():
+                guard.request("peer")
             if guard.triggered:
-                break
+                if stop_at is None:
+                    dist.announce_shutdown(t_env)
+                    with rec.span("preempt.barrier", t_env=t_env):
+                        stop_at, stop_ok = dist.negotiate_stop_step(
+                            t_env, res.preempt_barrier_timeout_s)
+                if not stop_ok or t_env >= stop_at:
+                    break
             if pulse is not None:
                 pulse.tick_iteration(t_env, episode)
             if trc is not None:
@@ -1748,7 +1793,8 @@ def run_sequential(exp: Experiment, logger: Logger,
                             return save_checkpoint(
                                 model_dir, t_env, _ckpt_state(),
                                 gather_retries=res.dispatch_retries,
-                                gather_backoff_s=res.retry_backoff_s)
+                                gather_backoff_s=res.retry_backoff_s,
+                                topology=_save_topology())
                         finally:
                             save_lock.release()
                 # retry only single-process: in multi-host the save is a
@@ -2014,23 +2060,47 @@ def run_sequential(exp: Experiment, logger: Logger,
                                       "checkpoint")
                             if wd is not None else nullcontext())
                 try:
-                    # same single-process-only retry policy as the
-                    # cadence save (a one-sided retry of the lockstep
-                    # multi-host collective would deadlock its peers) —
-                    # and an orderly preemption exit must STAY orderly:
-                    # a save that still fails falls back to the newest
-                    # published checkpoint instead of turning the
-                    # exit-0 resume hint into a crash
                     with deadline:
-                        save_to = watchdog.retry_call(
-                            lambda: save_checkpoint(
-                                model_dir, t_env, _ckpt_state(),
-                                gather_retries=res.dispatch_retries,
-                                gather_backoff_s=res.retry_backoff_s),
-                            attempts=(1 + res.dispatch_retries
-                                      if jax.process_count() == 1 else 1),
-                            backoff_s=res.retry_backoff_s,
-                            label="checkpoint.emergency")
+                        if stop_ok:
+                            # same single-process-only retry policy as
+                            # the cadence save (a one-sided retry of the
+                            # lockstep multi-host collective would
+                            # deadlock its peers) — and an orderly
+                            # preemption exit must STAY orderly: a save
+                            # that still fails degrades to the per-host
+                            # shard save below instead of turning the
+                            # exit-0 resume hint into a crash
+                            try:
+                                save_to = watchdog.retry_call(
+                                    lambda: save_checkpoint(
+                                        model_dir, t_env, _ckpt_state(),
+                                        gather_retries=res.dispatch_retries,
+                                        gather_backoff_s=res.retry_backoff_s,
+                                        topology=_save_topology()),
+                                    attempts=(1 + res.dispatch_retries
+                                              if jax.process_count() == 1
+                                              else 1),
+                                    backoff_s=res.retry_backoff_s,
+                                    label="checkpoint.emergency")
+                            except Exception:  # noqa: BLE001
+                                log.exception(
+                                    "collective emergency checkpoint "
+                                    "failed (a peer died mid-gather?) — "
+                                    "degrading to the per-host shard "
+                                    "save")
+                        if save_to is None:
+                            # degraded exit (docs/RESILIENCE.md §6): the
+                            # peer barrier failed or the collective save
+                            # died — write THIS host's addressable shard
+                            # only (no collectives, cannot hang on a
+                            # dead peer); restore_elastic reassembles
+                            # the set, find_checkpoint skips it unless
+                            # every shard landed
+                            with rec.span("checkpoint.shard_save",
+                                          t_env=t_env):
+                                save_to = save_checkpoint_shards(
+                                    model_dir, t_env, _ckpt_state(),
+                                    topology=_save_topology())
                 except Exception:  # noqa: BLE001 — exit path stays orderly
                     log.exception(
                         "emergency checkpoint failed on the preemption "
@@ -2171,6 +2241,13 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
     nonfinite_streak = 0
     nonfinite_total = 0
     restores = 0
+    # coordinated preemption (docs/RESILIENCE.md §6): stop_ok=False
+    # after a failed peer negotiation degrades the exit to the per-host
+    # shard save. Sebulba cuts at its own t_env (sanity_check rejects
+    # sebulba×dp, so there is no multi-host sebulba to step in lockstep
+    # toward a consensus cut).
+    stop_at = None
+    stop_ok = True
 
     # ---- shared driver-helper kit (graftlattice) ----------------------
     # default_wd stays None: each thread passes awd= explicitly (one
@@ -2397,6 +2474,21 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
         saves."""
         return graftpop.PopState(ts=ts_, spec=spec) if P else ts_
 
+    def _save_topology():
+        """The topology stamp every sebulba save carries (meta.json) —
+        symmetric with the classic loop's, so a classic resume of a
+        sebulba save (or vice versa) sees the loop-shape change and
+        logs/routes it (docs/RESILIENCE.md §6)."""
+        topo = {"loop": "sebulba",
+                "sebulba": {"actor_devices": sb.actor_devices,
+                            "learner_devices": sb.learner_devices}}
+        if P:
+            ema = getattr(train_acc, "member_return_ema", None)
+            if ema and all(v is not None for v in ema):
+                topo["member_ranking"] = sorted(
+                    range(P), key=lambda m: ema[m], reverse=True)
+        return topo
+
     def _place(found_):
         """(rs, ls, t_env) freshly initialized or restored. The restore
         streams each leaf STRAIGHT onto its mesh
@@ -2420,8 +2512,9 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
             # spec is config-determined and the two are identical.
             shapes = jax.eval_shape(
                 lambda: graftpop.init_population(exp, cfg))[0]
-            ps = load_checkpoint(dirname, _ckpt_state(shapes),
-                                 verify=False)
+            ps, _ = elastic.resume_state(dirname, _ckpt_state(shapes),
+                                         verify=False,
+                                         topology={"loop": "sebulba"})
             rs, ls = seb.place(ps.ts)
             rs = rs.replace(t_env=jax.device_put(
                 jnp.full((P,), step, jnp.int32), rs.t_env.sharding))
@@ -2430,11 +2523,11 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
             return rs, ls, step
         shapes = jax.eval_shape(lambda: exp.init_train_state(cfg.seed))
         rs_shape, ls_shape = seb.split_shapes(shapes)
-        ts = load_checkpoint_sharded(
+        ts = elastic.resume_state(
             dirname, shapes,
             seb.join(seb.runner_shardings(rs_shape),
                      seb.learner_shardings(ls_shape)),
-            verify=False)
+            verify=False, topology={"loop": "sebulba"})[0]
         rs, ls = seb.split_shapes(ts)
         # keep the canonical placement for the restored cursor
         rs = rs.replace(t_env=jax.device_put(
@@ -2471,6 +2564,7 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
         nonlocal ls, t_env, episode, buffer_filled, key, train_infos
         nonlocal nonfinite_streak, nonfinite_total
         nonlocal last_log_t, last_save_t, last_log_time
+        nonlocal stop_at, stop_ok
         stop_event.clear()
         actor_failure.clear()
         with cond:
@@ -2487,7 +2581,21 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
             while not guard.triggered:
                 resilience.fire("driver.iteration", t_env=t_env,
                                 guard=guard)
+                # coordinated preemption (docs/RESILIENCE.md §6):
+                # propagate a peer's announced shutdown, then negotiate
+                # once and cut HERE — the actor thread exits on the
+                # trigger, so the learner cannot step toward a later
+                # consensus target (and sanity_check rejects sebulba×dp,
+                # so there is no multi-host sebulba peer to align with;
+                # the negotiation only decides collective-vs-shard save)
+                if not guard.triggered and dist.peer_shutdown_requested():
+                    guard.request("peer")
                 if guard.triggered:
+                    if stop_at is None:
+                        dist.announce_shutdown(t_env)
+                        with rec.span("preempt.barrier", t_env=t_env):
+                            stop_at, stop_ok = dist.negotiate_stop_step(
+                                t_env, res.preempt_barrier_timeout_s)
                     break
                 if pulse is not None:
                     pulse.tick_iteration(t_env, episode)
@@ -2604,7 +2712,8 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                             model_dir, t_env,
                             _ckpt_state(_snapshot_state()),
                             gather_retries=res.dispatch_retries,
-                            gather_backoff_s=res.retry_backoff_s)
+                            gather_backoff_s=res.retry_backoff_s,
+                            topology=_save_topology())
                     finally:
                         save_lock.release()
             save_to = watchdog.retry_call(
@@ -2812,14 +2921,32 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
                             if wd is not None else nullcontext())
                 try:
                     with deadline:
-                        save_to = watchdog.retry_call(
-                            lambda: save_checkpoint(
-                                model_dir, t_env, _ckpt_state(ts),
-                                gather_retries=res.dispatch_retries,
-                                gather_backoff_s=res.retry_backoff_s),
-                            attempts=1 + res.dispatch_retries,
-                            backoff_s=res.retry_backoff_s,
-                            label="checkpoint.emergency")
+                        if stop_ok:
+                            try:
+                                save_to = watchdog.retry_call(
+                                    lambda: save_checkpoint(
+                                        model_dir, t_env, _ckpt_state(ts),
+                                        gather_retries=res.dispatch_retries,
+                                        gather_backoff_s=res.retry_backoff_s,
+                                        topology=_save_topology()),
+                                    attempts=1 + res.dispatch_retries,
+                                    backoff_s=res.retry_backoff_s,
+                                    label="checkpoint.emergency")
+                            except Exception:  # noqa: BLE001
+                                log.exception(
+                                    "collective emergency checkpoint "
+                                    "failed on the sebulba exit — "
+                                    "degrading to the per-host shard "
+                                    "save")
+                        if save_to is None:
+                            # degraded exit (docs/RESILIENCE.md §6):
+                            # write this host's addressable shard only —
+                            # no collectives, cannot hang on a dead peer
+                            with rec.span("checkpoint.shard_save",
+                                          t_env=t_env):
+                                save_to = save_checkpoint_shards(
+                                    model_dir, t_env, _ckpt_state(ts),
+                                    topology=_save_topology())
                 except Exception:  # noqa: BLE001 — exit stays orderly
                     log.exception("emergency checkpoint failed on the "
                                   "sebulba exit path")

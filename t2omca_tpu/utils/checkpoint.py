@@ -30,8 +30,9 @@ import hashlib
 import json
 import logging
 import os
+import re
 import shutil
-from typing import Any, List, Optional, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 from flax import serialization
@@ -93,6 +94,40 @@ def _obs_layout(state: Any) -> Optional[str]:
             else "dense")
 
 
+def _population_size(state: Any) -> Optional[int]:
+    """Leading (P,) member count of a graftpop ``PopState`` (None for a
+    bare TrainState). Works on concrete, host-numpy and eval_shape trees
+    AND on the raw state-dict form (``{"ts": ..., "spec": ...}``)."""
+    spec = (state.get("spec") if isinstance(state, dict)
+            else getattr(state, "spec", None))
+    if spec is None or not (isinstance(state, dict)
+                            or hasattr(state, "ts")):
+        return None
+    leaves = jax.tree_util.tree_leaves(spec)
+    if not leaves:
+        return None
+    shape = getattr(leaves[0], "shape", None)
+    return int(shape[0]) if shape else None
+
+
+def _topology_stamp(state: Any, extra: Optional[dict] = None) -> dict:
+    """The ``meta.json`` topology stamp (docs/RESILIENCE.md §6): enough
+    about the WRITING run's shape that a resume under a different shape is
+    detected and routed through :func:`restore_elastic` instead of
+    crashing deep inside ``from_state_dict``. The driver threads loop
+    shape / mesh shape / sebulba split through ``extra``; the base facts
+    are derivable from the state + runtime here. Absent on pre-graftmorph
+    checkpoints — readers must treat a missing stamp as "unknown", not as
+    a mismatch."""
+    stamp = {"device_count": jax.device_count(),
+             "process_count": jax.process_count(),
+             "population": _population_size(state),
+             "format": _state_format(state)}
+    if extra:
+        stamp.update(extra)
+    return stamp
+
+
 def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
     h = hashlib.sha256()
     with open(path, "rb") as f:
@@ -116,7 +151,8 @@ def _fsync_path(path: str) -> None:
 
 def save_checkpoint(path: str, t_env: int, state: Any,
                     gather_retries: int = 2,
-                    gather_backoff_s: float = 0.5) -> str:
+                    gather_backoff_s: float = 0.5,
+                    topology: Optional[dict] = None) -> str:
     """Write ``<path>/<t_env>/{state.msgpack, meta.json}`` crash-safely.
 
     ``gather_retries``/``gather_backoff_s`` bound the per-leaf allgather
@@ -150,9 +186,18 @@ def save_checkpoint(path: str, t_env: int, state: Any,
     disk is always the complete global state, restorable on any topology
     (exact-resume re-shards; model-only fallback via
     ``load_learner_state``). A per-shard on-disk format (one file per
-    process, orbax-style) remains the escape hatch if even the one-leaf
-    transient ever dominates."""
+    process, orbax-style) exists as :func:`save_checkpoint_shards` — the
+    degraded path for a preemption with dead peers, where this function's
+    collectives would hang.
+
+    ``topology`` merges driver-side facts (loop shape, mesh shape,
+    sebulba split, member ranking) into the ``meta.json`` topology stamp
+    (docs/RESILIENCE.md §6)."""
     d = os.path.join(path, str(int(t_env)))
+    # stamped BEFORE the multi-host gather: the global device/process
+    # counts are the WRITING topology by definition — capture them while
+    # the state still carries its device placement
+    stamp = _topology_stamp(state, topology)
     # fault-injection point (docs/RESILIENCE.md §4): the gather-to-host
     # step — the multi-host allgather sequence below, or the plain
     # device_get serialize on one process. Raising a transient error here
@@ -240,7 +285,8 @@ def save_checkpoint(path: str, t_env: int, state: Any,
         json.dump({"format": _state_format(state),
                    "obs_layout": _obs_layout(state),
                    "t_env": int(t_env), "sha256": digest,
-                   "bytes": os.path.getsize(state_path)}, f)
+                   "bytes": os.path.getsize(state_path),
+                   "topology": stamp}, f)
         f.flush()
         os.fsync(f.fileno())
 
@@ -265,6 +311,220 @@ def save_checkpoint(path: str, t_env: int, state: Any,
     return d
 
 
+#: ``shard.<i>-of-<n>.msgpack`` — one host's slice of a degraded save
+_SHARD_RE = re.compile(r"^shard\.(\d+)-of-(\d+)\.msgpack$")
+
+
+def _shard_file(i: int, n: int) -> Tuple[str, str]:
+    return f"shard.{i}-of-{n}.msgpack", f"shard.{i}-of-{n}.json"
+
+
+def _write_file_atomic(dirname: str, name: str, blob: bytes) -> None:
+    """tmp-write + fsync + rename INSIDE an already-visible directory —
+    per-file atomicity for the shard path, where no host owns the
+    directory and the staged-directory publish of the complete path is
+    impossible (peers write into the same step dir concurrently)."""
+    tmp = os.path.join(dirname, f".tmp.{name}")
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, os.path.join(dirname, name))
+
+
+def write_shard(path: str, t_env: int, shard_index: int, num_shards: int,
+                host_state: Any, sharded_paths: Sequence[str] = (),
+                topology: Optional[dict] = None) -> str:
+    """Write ONE host's shard of a degraded (``partial``) checkpoint into
+    ``<path>/<t_env>/`` — no collectives, no cross-host coordination, so
+    it cannot hang on a dead peer.
+
+    ``host_state`` is this host's LOCAL view of the train state: sharded
+    leaves hold only the local axis-0 block, replicated leaves the full
+    value. ``sharded_paths`` names (``jax.tree_util.keystr`` over the
+    state-dict) the leaves that are axis-0 blocks — the assembly rule in
+    :func:`_assemble_shards` concatenates exactly those in shard order
+    and takes shard 0's copy of everything else. All repo shardings are
+    ``P("data")`` on the leading axis or fully replicated
+    (``parallel/mesh.py``), so axis-0 concat is the only assembly rule.
+
+    Layout per shard: ``shard.<i>-of-<n>.msgpack`` (the state-dict) +
+    ``shard.<i>-of-<n>.json`` (its sha256/bytes + ``sharded_paths``),
+    both tmp-written + renamed for per-file atomicity. Every surviving
+    host also writes an identical, deterministic ``meta.json`` stamped
+    ``partial`` (sorted keys; last-writer-wins is byte-idempotent), so
+    the step dir is self-describing even when only some shards landed —
+    :func:`verify_checkpoint` treats it as valid only when ALL ``n``
+    shards are present and intact."""
+    d = os.path.join(path, str(int(t_env)))
+    os.makedirs(d, exist_ok=True)
+    sd = serialization.to_state_dict(host_state)
+    blob = serialization.to_bytes(sd)
+    sname, jname = _shard_file(int(shard_index), int(num_shards))
+    _write_file_atomic(d, sname, blob)
+    side = {"shard": int(shard_index), "shards": int(num_shards),
+            "t_env": int(t_env), "sha256": hashlib.sha256(blob).hexdigest(),
+            "bytes": len(blob), "sharded_paths": sorted(sharded_paths)}
+    del blob
+    _write_file_atomic(d, jname,
+                       json.dumps(side, sort_keys=True).encode())
+    meta = {"format": _state_format(host_state),
+            "obs_layout": _obs_layout(host_state),
+            "t_env": int(t_env), "partial": True,
+            "shards": int(num_shards),
+            "topology": _topology_stamp(host_state, topology)}
+    _write_file_atomic(d, "meta.json",
+                       json.dumps(meta, sort_keys=True).encode())
+    _fsync_path(d)
+    os.makedirs(path, exist_ok=True)
+    _fsync_path(path)
+    return d
+
+
+def save_checkpoint_shards(path: str, t_env: int, state: Any,
+                           topology: Optional[dict] = None) -> str:
+    """Degraded emergency save: each process writes ONLY its local shard
+    via :func:`write_shard` — the fallback when the coordinated
+    preemption barrier fails or :func:`save_checkpoint`'s gather dies
+    mid-collective (a peer is gone, so any collective would hang). The
+    resulting save is stamped ``partial`` and is valid only once all
+    shards landed; :func:`restore_host_state` reassembles it into the
+    ordinary global state-dict on ANY later host count
+    (docs/RESILIENCE.md §6)."""
+    import numpy as _np
+    idx, n = jax.process_index(), jax.process_count()
+    # fault-injection point (docs/RESILIENCE.md §4): the degraded
+    # shard write itself — the driver's exit path catches a failure
+    # here and leaves the last cadence save as the resume point
+    resilience.fire("checkpoint.shard_save", t_env=int(t_env),
+                    shard=idx, shards=n)
+    sd = serialization.to_state_dict(state)
+    kp_leaves, treedef = jax.tree_util.tree_flatten_with_path(sd)
+    sharded_paths, host_leaves = [], []
+    for kp, x in kp_leaves:
+        if not isinstance(x, jax.Array):
+            host_leaves.append(x)
+            continue
+        if x.is_fully_replicated:
+            host_leaves.append(_np.asarray(x))
+            continue
+        if x.is_fully_addressable:
+            host_leaves.append(jax.device_get(x))
+            continue
+        # data-sharded leaf: this host's axis-0 block, deduped (a
+        # device may hold a replica of another's block under dp) and
+        # ordered by global offset
+        blocks = {}
+        for s in x.addressable_shards:
+            start = s.index[0].start or 0 if s.index else 0
+            blocks.setdefault(start, s.data)
+        block = _np.concatenate(
+            [_np.asarray(blocks[k]) for k in sorted(blocks)], axis=0)
+        sharded_paths.append(jax.tree_util.keystr(kp))
+        host_leaves.append(block)
+    host_sd = jax.tree_util.tree_unflatten(treedef, host_leaves)
+    del kp_leaves, host_leaves
+    d = write_shard(path, t_env, idx, n, host_sd,
+                    sharded_paths=sharded_paths, topology=topology)
+    logger.warning(
+        "save_checkpoint_shards t_env=%d: wrote degraded shard %d/%d "
+        "under %s (valid for resume only once all shards land)",
+        int(t_env), idx, n, d)
+    return d
+
+
+def _shard_groups(dirname: str) -> dict:
+    """``{n: {i: filename}}`` for the shard msgpacks present in a dir."""
+    groups: dict = {}
+    try:
+        names = os.listdir(dirname)
+    except OSError:
+        return groups
+    for name in names:
+        m = _SHARD_RE.match(name)
+        if m:
+            groups.setdefault(int(m.group(2)), {})[int(m.group(1))] = name
+    return groups
+
+
+def _complete_shard_group(dirname: str, verify: bool = True
+                          ) -> Optional[int]:
+    """The shard count ``n`` of a COMPLETE, intact shard set under
+    ``dirname`` (all ``n`` msgpacks + sidecars present, byte counts and
+    — when ``verify`` — SHA-256 digests matching), else None. Multiple
+    ``n`` groups can coexist if saves from different host counts landed
+    on the same step; any complete group qualifies, largest first."""
+    for n, idxs in sorted(_shard_groups(dirname).items(), reverse=True):
+        if set(idxs) != set(range(n)):
+            continue
+        ok = True
+        for i in range(n):
+            sname, jname = _shard_file(i, n)
+            spath = os.path.join(dirname, sname)
+            jpath = os.path.join(dirname, jname)
+            try:
+                with open(jpath) as f:
+                    side = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                ok = False
+                break
+            nbytes = side.get("bytes")
+            if nbytes is not None and os.path.getsize(spath) != nbytes:
+                ok = False
+                break
+            if verify and side.get("sha256") is not None \
+                    and _sha256_file(spath) != side["sha256"]:
+                ok = False
+                break
+        if ok:
+            return n
+    return None
+
+
+def _assemble_shards(dirname: str, n: int) -> Any:
+    """Reassemble a complete ``partial`` save into the ordinary global
+    state-dict — pure host numpy, works on ANY current host count (the
+    shard count ``n`` is a property of the save, not the reader).
+    Leaves named in the sidecars' ``sharded_paths`` concatenate along
+    axis 0 in shard order; everything else (replicated leaves) takes
+    shard 0's copy. Peak host RAM is the assembled state plus ONE
+    leaf's source blocks — each leaf's slots across the shard list are
+    dropped as its concat completes, so there is never a 2x-state
+    transient."""
+    import numpy as _np
+    sharded: set = set()
+    flats, treedef0 = [], None
+    for i in range(n):
+        sname, jname = _shard_file(i, n)
+        with open(os.path.join(dirname, jname)) as f:
+            sharded.update(json.load(f).get("sharded_paths") or [])
+        with open(os.path.join(dirname, sname), "rb") as f:
+            sd = serialization.msgpack_restore(f.read())
+        kp_leaves, treedef = jax.tree_util.tree_flatten_with_path(sd)
+        if treedef0 is None:
+            treedef0 = treedef
+        elif treedef != treedef0:
+            raise CheckpointIntegrityError(
+                f"partial checkpoint {dirname}: shard {i} has a "
+                f"different tree structure than shard 0 — the shards "
+                f"were written by incompatible runs; resume from an "
+                f"older complete step")
+        flats.append([list(p) for p in kp_leaves])
+    out = []
+    for col in range(len(flats[0])):
+        kp = flats[0][col][0]
+        if jax.tree_util.keystr(kp) in sharded:
+            parts = [flats[i][col][1] for i in range(n)]
+            for i in range(n):
+                flats[i][col][1] = None      # free source before concat
+            out.append(_np.concatenate(
+                [_np.asarray(p) for p in parts], axis=0))
+            del parts
+        else:
+            out.append(flats[0][col][1])
+    return jax.tree_util.tree_unflatten(treedef0, out)
+
+
 def verify_checkpoint(dirname: str) -> bool:
     """True iff ``dirname`` holds a restorable checkpoint.
 
@@ -274,10 +534,18 @@ def verify_checkpoint(dirname: str) -> bool:
     last, so a sidecar implies the state blob completed). Sidecar-less
     directories (pre-v2, or a torn legacy write that died mid-state) fall
     back to a full msgpack parse — expensive, but only ever paid for
-    legacy candidates actually under consideration."""
+    legacy candidates actually under consideration.
+
+    ``partial`` (per-host shard) saves are valid ONLY when every one of
+    their ``n`` shards is present and intact — completeness is a gate,
+    not a preference: a multi-host emergency save interrupted after some
+    shards landed must NOT look newest-valid on the host whose shard
+    completed, or resume diverges per host. An incomplete shard set
+    returns False and :func:`find_checkpoint` skips back to the newest
+    complete step."""
     state_path = os.path.join(dirname, "state.msgpack")
     if not os.path.isfile(state_path):
-        return False
+        return _complete_shard_group(dirname, verify=True) is not None
     meta_path = os.path.join(dirname, "meta.json")
     if os.path.isfile(meta_path):
         try:
@@ -405,7 +673,22 @@ def restore_host_state(dirname: str, verify: bool = True,
     meta = _read_meta(dirname)
     if layout_target is not None:
         _check_obs_layout(meta, layout_target, dirname)
-    with open(os.path.join(dirname, "state.msgpack"), "rb") as f:
+    state_path = os.path.join(dirname, "state.msgpack")
+    if not os.path.isfile(state_path):
+        # degraded per-host shard save (docs/RESILIENCE.md §6): valid
+        # only when complete; reassembles into the ordinary global
+        # state-dict on ANY current host count, so every caller above
+        # this point (load_checkpoint, the sharded/elastic restores,
+        # the serve exporter) reads partial saves transparently
+        n = _complete_shard_group(dirname, verify=verify)
+        if n is None:
+            raise CheckpointIntegrityError(
+                f"checkpoint {dirname} has neither state.msgpack nor a "
+                f"complete shard set — an interrupted partial save; "
+                f"resume from an older step (find_checkpoint skips "
+                f"incomplete partial saves automatically)")
+        return meta, _assemble_shards(dirname, n)
+    with open(state_path, "rb") as f:
         data = f.read()
     if verify and meta is not None and meta.get("sha256") is not None:
         digest = hashlib.sha256(data).hexdigest()
@@ -495,6 +778,91 @@ def _lift_population(raw: Any, target: Any) -> Any:
                 jax.random.fold_in(jax.numpy.asarray(k[m]), m)))
                 for m in range(1, p)])
     return {"ts": stacked, "spec": serialization.to_state_dict(spec_host)}
+
+
+def _resalt_member_keys(runner: Any, members: Sequence[int]) -> None:
+    """Re-salt the listed members' ROLLOUT keys (``runner.key`` in a
+    stacked state-dict) with a per-member ``fold_in`` — shared logic of
+    :func:`_lift_population` and :func:`_reshape_population`: any member
+    whose key was REPLICATED from another's must diverge or both draw
+    identical trajectories forever (the diversity defect pbt_step's
+    exploit re-salt exists for)."""
+    import numpy as _np
+    if not (isinstance(runner, dict) and "key" in runner and members):
+        return
+    k = _np.array(runner["key"])          # owned copy: rows mutate below
+    for m in members:
+        k[m] = _np.asarray(jax.device_get(
+            jax.random.fold_in(jax.numpy.asarray(k[m]), m)))
+    runner["key"] = k
+
+
+def _reshape_population(raw: Any, target: Any,
+                        member_ranking: Optional[Sequence[int]] = None
+                        ) -> Any:
+    """Elastic v5 → v5 shim (generalizes :func:`_lift_population`, which
+    only covers P=1 → P): resize a population state-dict's leading
+    ``(P_src,)`` member axis to the template's ``P_dst``.
+
+    Shrink keeps ``member_ranking[:P_dst]`` when a ranking is given (the
+    save-side stamp records one from the host EMA return stats when they
+    exist — docs/RESILIENCE.md §6) else the member prefix; the prefix
+    path slices views, no host copy. Grow keeps all ``P_src`` members
+    and replicates member ``m % P_src`` into each new slot ``m``, with
+    the new members' rollout keys ``fold_in``-re-salted so no two
+    members share streams. Both ``ts`` and ``spec`` rows move together —
+    a surviving member keeps its own hyperparameters."""
+    import numpy as _np
+    p_dst = int(jax.tree_util.tree_leaves(target.spec)[0].shape[0])
+    p_src = int(_np.asarray(
+        jax.tree_util.tree_leaves(raw["spec"])[0]).shape[0])
+    if p_src == p_dst:
+        return raw
+    if p_dst < p_src:
+        if member_ranking is not None:
+            idx = [int(i) for i in list(member_ranking)[:p_dst]]
+            if sorted(set(idx)) != sorted(idx) or not all(
+                    0 <= i < p_src for i in idx):
+                raise ValueError(
+                    f"member_ranking {list(member_ranking)!r} is not a "
+                    f"permutation prefix of range({p_src}) — cannot "
+                    f"shrink the population to P={p_dst}")
+        else:
+            idx = list(range(p_dst))
+        salted: List[int] = []       # survivors keep their own streams
+    else:
+        idx = list(range(p_src)) + [m % p_src for m in range(p_src, p_dst)]
+        salted = list(range(p_src, p_dst))
+
+    prefix = idx == list(range(p_dst))
+
+    def _take(a):
+        a = _np.asarray(a)
+        if prefix:
+            return a[:p_dst]         # stride view — no host copy
+        return _np.take(a, _np.asarray(idx), axis=0)
+
+    out = {"ts": jax.tree.map(_take, raw["ts"]),
+           "spec": jax.tree.map(_take, raw["spec"])}
+    _resalt_member_keys(out["ts"].get("runner")
+                        if isinstance(out["ts"], dict) else None, salted)
+    logger.info(
+        "_reshape_population: %s P=%d -> P=%d (members %s%s)",
+        "shrank" if p_dst < p_src else "grew", p_src, p_dst, idx,
+        f", re-salted {salted}" if salted else "")
+    return out
+
+
+def _extract_member(raw: Any,
+                    member_ranking: Optional[Sequence[int]] = None) -> Any:
+    """Elastic v5 → v4 shim: pull ONE member (the ranking's best when
+    given, else member 0) out of a population state-dict so a population
+    run restores into a bare-TrainState template — the P → classic leg
+    of the elastic matrix. Per-leaf axis-0 indexing returns views; the
+    spec rows are dropped (a classic run has no PBT grids)."""
+    import numpy as _np
+    m = int(member_ranking[0]) if member_ranking else 0
+    return jax.tree.map(lambda a: _np.asarray(a)[m], raw["ts"])
 
 
 def _migrate_raw(meta: Optional[dict], raw: Any, target: Any) -> Any:
@@ -610,7 +978,16 @@ def load_checkpoint_sharded(dirname: str, template: Any, shardings: Any,
     device memory is the sharded state plus one leaf, never 1 + 1/N
     rings. ``template`` and ``shardings`` must be structure-identical
     (``DataParallel.state_shardings(template)`` builds the latter)."""
-    restored = _restore_into(dirname, template, verify)
+    return _place_streamed(_restore_into(dirname, template, verify),
+                           shardings)
+
+
+def _place_streamed(restored: Any, shardings: Any) -> Any:
+    """The leaf-streaming placement core (ADVICE r5) shared by
+    :func:`load_checkpoint_sharded` and :func:`restore_elastic`: each
+    host leaf is ``device_put`` under its sharding one at a time and its
+    host copy dropped immediately — peak device memory is the sharded
+    state plus ONE leaf, never a full single-device materialization."""
     flat, treedef = jax.tree_util.tree_flatten(restored)
     # the flat list is now the ONLY holder of the host leaves — without
     # this, `restored` would pin every leaf and the per-leaf free below
@@ -628,15 +1005,76 @@ def load_checkpoint_sharded(dirname: str, template: Any, shardings: Any,
     return jax.tree_util.tree_unflatten(treedef, placed)
 
 
+def restore_elastic(dirname: str, template: Any, shardings: Any = None,
+                    verify: bool = True,
+                    member_ranking: Optional[Sequence[int]] = None) -> Any:
+    """Restore ANY v3–v5 checkpoint into the CURRENT run's topology
+    (docs/RESILIENCE.md §6) — the elastic superset of
+    :func:`load_checkpoint` / :func:`load_checkpoint_sharded`:
+
+    * **format**: the stepwise v2→v3→v4→v5 shims of :func:`_migrate_raw`
+      run first, exactly as on the rigid paths;
+    * **population**: a ``(P_src,)`` checkpoint resizes into a
+      ``(P_dst,)`` template via :func:`_reshape_population` (shrink
+      keeps the stamped best-ranked members else the prefix; grow
+      replicates with ``fold_in``-re-salted rollout keys), and a
+      population checkpoint restores into a BARE TrainState template via
+      :func:`_extract_member`;
+    * **devices / loop shape**: the state-dict is topology-free (a
+      complete save holds the global state; a partial save reassembles
+      in :func:`restore_host_state`), so dp N↔M and classic↔Sebulba are
+      pure placement — pass the CURRENT mesh's ``shardings`` and each
+      leaf streams straight to its new placement
+      (:func:`_place_streamed`, no full-tree single-device transient);
+      with ``shardings=None`` leaves restore host-side as numpy exactly
+      like :func:`load_checkpoint`.
+
+    ``member_ranking`` (best first) overrides the ranking stamped into
+    ``meta.json`` by the save side; when neither exists a shrink keeps
+    the member prefix. Fires the ``checkpoint.elastic`` resilience hook
+    after the host read so chaos tests can fault the routing boundary
+    itself."""
+    meta, raw = restore_host_state(dirname, verify=verify,
+                                   layout_target=template)
+    # fault-injection point (docs/RESILIENCE.md §4): the elastic
+    # restore/reshape boundary — after the (verified) host read, before
+    # any reshaping or device placement
+    resilience.fire("checkpoint.elastic", dirname=dirname,
+                    format=(meta or {}).get("format"))
+    if member_ranking is None and meta is not None:
+        member_ranking = (meta.get("topology") or {}).get("member_ranking")
+    pop_target = hasattr(template, "ts") and hasattr(template, "spec")
+    if not pop_target and isinstance(raw, dict) and "spec" in raw:
+        raw = _extract_member(raw, member_ranking)
+    raw = _migrate_raw(meta, raw, template)
+    if pop_target and isinstance(raw, dict) and "spec" in raw:
+        raw = _reshape_population(raw, template, member_ranking)
+    try:
+        restored = serialization.from_state_dict(template, raw)
+    except (KeyError, ValueError) as e:
+        raise ValueError(
+            f"checkpoint {dirname} does not match the configured "
+            f"train-state structure even after elastic reshaping: {e} "
+            f"(docs/RESILIENCE.md §6)") from e
+    _check_leaf_shapes(template, restored, dirname)
+    if shardings is None:
+        return restored
+    return _place_streamed(restored, shardings)
+
+
 def load_learner_state(dirname: str, target: Any) -> Any:
     """Restore ONLY the learner subtree (params/target/optimizer) into a
     full train-state template — shape-independent of the runner/replay
     config, so a model trained at one scale (or on a DP mesh) evaluates
     under any other. Matches the reference's model-only checkpoint
     semantics (``/root/reference/per_run.py:185-187``): runner-side
-    normalizer statistics start fresh."""
-    with open(os.path.join(dirname, "state.msgpack"), "rb") as f:
-        raw = serialization.msgpack_restore(f.read())
+    normalizer statistics start fresh. Reads through
+    :func:`restore_host_state` so partial (per-host shard) saves
+    reassemble transparently; the integrity re-hash is skipped — the
+    caller just paid it in :func:`find_checkpoint`."""
+    _, raw = restore_host_state(dirname, verify=False)
+    if isinstance(raw, dict) and "spec" in raw:
+        raw = _extract_member(raw)   # population save: member 0's model
     learner = serialization.from_state_dict(target.learner, raw["learner"])
     # same silent-wrong-shape hazard as the full restore: a model-config
     # mismatch (e.g. different emb) must fail HERE with the leaf named,

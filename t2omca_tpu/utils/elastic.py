@@ -1,0 +1,154 @@
+"""graftmorph — topology-elastic resume routing (docs/RESILIENCE.md §6).
+
+A checkpoint written by one topology (device count, dp split, loop shape,
+population size, host count) must restore into whatever topology the
+CURRENT run has: a preempted pod slice comes back smaller, a resized
+reservation comes back larger, a config change flips classic↔Sebulba or
+resizes the population. The on-disk state is already topology-free — a
+complete save holds the GLOBAL state-dict and a partial (per-host shard)
+save reassembles into one (``utils/checkpoint.py``) — so elasticity is a
+ROUTING problem: read the ``meta.json`` topology stamp, compare it with
+the current run's shape, and pick the restore path that reshapes what
+actually differs instead of crashing deep inside ``from_state_dict``.
+
+This module is that router. ``utils/checkpoint.py`` owns the mechanics
+(:func:`~t2omca_tpu.utils.checkpoint.restore_elastic`, the shard
+write/assembly, the ``_reshape_population`` shim); here lives the
+driver-facing surface:
+
+* :func:`current_topology` — the CURRENT run's stamp, the same shape
+  ``save_checkpoint`` writes (so stamp comparison is symmetric);
+* :func:`topology_mismatch` — the human-readable diff between a saved
+  stamp and the current one (empty = same shape or unknown/pre-stamp
+  checkpoint);
+* :func:`resume_state` — the routing decision itself: same-shape resumes
+  keep the rigid fast paths bit-for-bit (``load_checkpoint`` /
+  ``load_checkpoint_sharded``); a population resize or a
+  population↔classic flip routes through ``restore_elastic``; a
+  stampless checkpoint that fails the rigid path structurally falls back
+  to the elastic path once before giving up.
+
+Device-count and loop-shape changes need no data movement at all — the
+driver builds its templates/shardings for the CURRENT mesh and the
+restore places each leaf under them (leaf-streamed, ADVICE r5) — so
+those mismatches are logged, not special-cased.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional, Sequence, Tuple
+
+from . import checkpoint as _ckpt
+
+logger = logging.getLogger(__name__)
+
+#: stamp keys compared host-side; everything else in the stamp is
+#: informational (mesh/sebulba details vary freely under placement)
+_COMPARED_KEYS = ("population", "device_count", "process_count", "loop")
+
+
+def current_topology(state: Any, loop: Optional[str] = None,
+                     mesh_shape: Optional[Sequence[int]] = None,
+                     sebulba: Optional[dict] = None,
+                     member_ranking: Optional[Sequence[int]] = None
+                     ) -> dict:
+    """The CURRENT run's topology stamp — the dict ``save_checkpoint``
+    writes into ``meta.json`` (``topology=``) and ``resume_state``
+    compares against. ``state`` may be concrete or an eval_shape
+    template (only shapes are read). ``loop`` names the driver shape
+    (``"classic"`` / ``"sebulba"``); ``member_ranking`` (best member
+    first, from the host EMA return stats when they exist) is what a
+    later shrink keeps."""
+    extra: dict = {}
+    if loop is not None:
+        extra["loop"] = loop
+    if mesh_shape is not None:
+        extra["mesh_shape"] = [int(x) for x in mesh_shape]
+    if sebulba is not None:
+        extra["sebulba"] = sebulba
+    if member_ranking is not None:
+        extra["member_ranking"] = [int(m) for m in member_ranking]
+    return _ckpt._topology_stamp(state, extra)
+
+
+def topology_mismatch(saved: Optional[dict],
+                      current: dict) -> List[str]:
+    """Human-readable differences between a checkpoint's stamp and the
+    current run's — empty when the shapes agree OR the checkpoint
+    predates the stamp (pre-graftmorph saves carry none; unknown is NOT
+    a mismatch, the rigid path must keep working on old checkpoints).
+    Only keys present in BOTH stamps compare — a stamp written without a
+    ``loop`` entry says nothing about loop shape."""
+    if not saved:
+        return []
+    diffs = []
+    for key in _COMPARED_KEYS:
+        if key in saved and key in current and saved[key] != current[key]:
+            diffs.append(f"{key}: saved {saved[key]!r} -> "
+                         f"current {current[key]!r}")
+    return diffs
+
+
+def _needs_elastic(saved: Optional[dict], current: dict) -> bool:
+    """True when the RAW STATE itself must be reshaped — today that is
+    exactly a population mismatch (P resize, or population↔classic).
+    Device/process/loop changes are placement-only: the rigid sharded
+    path already streams leaves onto the current mesh."""
+    if not saved or "population" not in saved:
+        return False
+    return saved["population"] != current.get("population")
+
+
+def resume_state(dirname: str, template: Any, shardings: Any = None,
+                 verify: bool = True,
+                 topology: Optional[dict] = None,
+                 member_ranking: Optional[Sequence[int]] = None
+                 ) -> Tuple[Any, bool]:
+    """Restore ``dirname`` into the CURRENT topology → ``(state,
+    used_elastic)`` — the driver's one resume entry point.
+
+    Same-shape resumes take the EXACT rigid paths that existed before
+    graftmorph (``load_checkpoint_sharded`` when ``shardings`` is given,
+    else ``load_checkpoint``) — bit-for-bit unchanged behavior, no
+    elastic hook fired. A stamped population mismatch routes through
+    :func:`~t2omca_tpu.utils.checkpoint.restore_elastic`; any other
+    stamped difference (device count, host count, loop shape) is logged
+    and handled by placement alone. A STAMPLESS checkpoint that fails
+    the rigid path with a structural error gets one elastic retry — the
+    pre-stamp analog of detection — before the original error
+    semantics apply."""
+    meta = _ckpt._read_meta(dirname)
+    saved = (meta or {}).get("topology")
+    current = _ckpt._topology_stamp(template, topology)
+    diffs = topology_mismatch(saved, current)
+    if _needs_elastic(saved, current):
+        logger.warning(
+            "resume_state: topology changed since %s was written (%s) — "
+            "routing through restore_elastic (docs/RESILIENCE.md §6)",
+            dirname, "; ".join(diffs))
+        return _ckpt.restore_elastic(
+            dirname, template, shardings=shardings, verify=verify,
+            member_ranking=member_ranking), True
+    if diffs:
+        logger.info(
+            "resume_state: placement-only topology change for %s (%s) — "
+            "leaves stream onto the current mesh, no reshape needed",
+            dirname, "; ".join(diffs))
+    try:
+        if shardings is not None:
+            return _ckpt.load_checkpoint_sharded(
+                dirname, template, shardings, verify=verify), False
+        return _ckpt.load_checkpoint(dirname, template,
+                                     verify=verify), False
+    except ValueError as e:
+        if saved is not None:
+            raise                    # stamped + same shape: a real
+            #                          config mismatch, not elasticity
+        logger.warning(
+            "resume_state: rigid restore of stampless checkpoint %s "
+            "failed structurally (%s) — retrying through "
+            "restore_elastic once", dirname, e)
+        return _ckpt.restore_elastic(
+            dirname, template, shardings=shardings, verify=verify,
+            member_ranking=member_ranking), True

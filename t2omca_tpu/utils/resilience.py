@@ -124,6 +124,21 @@ logger = logging.getLogger(__name__)
 #:       fingerprint check (stage="fingerprint", bucket=, fingerprint=)
 #:       — raise at "fold" to poison a refresh (must be REFUSED while
 #:       the fleet keeps serving).
+#:   ``preempt.barrier``     t_env=<int>, processes=<int>
+#:       inside the coordinated-preemption stop-step negotiation
+#:       (parallel/distributed.negotiate_stop_step), before the bounded
+#:       KV-store barrier — raise to simulate a peer dying
+#:       mid-negotiation; the driver must degrade to the per-host
+#:       shard save instead of attempting a collective emergency save.
+#:   ``checkpoint.shard_save``   t_env=<int>, shard=<int>, shards=<int>
+#:       at the top of the degraded per-host shard write
+#:       (utils/checkpoint.save_checkpoint_shards) — raise to kill the
+#:       fallback save itself; the driver's exit path must survive and
+#:       leave the last cadence checkpoint as the resume point.
+#:   ``checkpoint.elastic``  dirname=<str>, format=<int|None>
+#:       inside restore_elastic after the (verified) host read, before
+#:       any topology reshape or device placement — raise to fault the
+#:       elastic resume boundary (docs/RESILIENCE.md §6).
 _FAULTS: Dict[str, List[Callable]] = {}
 
 

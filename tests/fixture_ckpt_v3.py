@@ -1,0 +1,89 @@
+"""Generator for the checked-in v3 fixture checkpoint
+(``tests/fixtures_ckpt_v3/``) — the e2e anchor for the full
+v3→v4→v5 migration chain (``utils/checkpoint._migrate_raw`` +
+``_lift_population``; docs/RESILIENCE.md §2).
+
+Shim-based migration tests synthesize the OLD tree from the NEW one
+(delete a key, call the shim), which silently co-evolves with the code
+under test: if a refactor changed what "v3" means, those tests would
+keep passing against the wrong bytes. The fixture pins real v3-era
+bytes in git instead. It is produced from the CURRENT writer by
+deleting the one runner field the v3 era predates (``env_params``,
+added v3→v4; ``rscale`` arrived v2→v3 and so IS present in a v3 tree)
+and stamping ``format: 3`` with no topology stamp — byte-for-byte what
+a v3-era writer published.
+
+Regenerate (only when the fixture config below must change — the WHOLE
+POINT is that the bytes stay frozen):
+
+    python -m tests.fixture_ckpt_v3
+
+The test half lives in ``tests/test_elastic.py``
+(``test_v3_fixture_migrates_*``) and restores these bytes into a bare
+v4 template and a P=2 population template.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+FIXTURE_STEP = 24
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "fixtures_ckpt_v3")
+
+
+def fixture_cfg(tmp_path="/tmp/v3fix"):
+    """The frozen fixture config — the test rebuilds templates from
+    EXACTLY this shape. Mirrors the resilience tiny config at its
+    smallest: the checked-in blob must stay a few hundred KiB."""
+    from t2omca_tpu.config import (EnvConfig, ModelConfig, ReplayConfig,
+                                   TrainConfig, sanity_check)
+    return sanity_check(TrainConfig(
+        t_max=60, batch_size_run=2, batch_size=4, test_interval=24,
+        test_nepisode=2, log_interval=12, runner_log_interval=12,
+        local_results_path=str(tmp_path), use_tensorboard=False,
+        epsilon_anneal_time=50,
+        env_args=EnvConfig(agv_num=3, mec_num=2, num_channels=2,
+                           episode_limit=6),
+        model=ModelConfig(emb=8, heads=2, depth=1, mixer_emb=8,
+                          mixer_heads=2, mixer_depth=1),
+        replay=ReplayConfig(buffer_size=8),
+    ))
+
+
+def main() -> str:
+    from flax import serialization
+
+    from t2omca_tpu.run import Experiment
+    from t2omca_tpu.utils.checkpoint import save_checkpoint
+
+    cfg = fixture_cfg()
+    exp = Experiment.build(cfg)
+    ts = exp.init_train_state(cfg.seed)
+    save_checkpoint(FIXTURE_DIR, FIXTURE_STEP, ts)
+
+    d = os.path.join(FIXTURE_DIR, str(FIXTURE_STEP))
+    state_path = os.path.join(d, "state.msgpack")
+    with open(state_path, "rb") as f:
+        raw = serialization.msgpack_restore(f.read())
+    # the v3 era predates env_params (added v3→v4) but HAS rscale
+    # (added v2→v3) — delete exactly the one field so the restore
+    # exercises the real v3→v4 inject shim, then v4→v5 lifting
+    del raw["runner"]["env_params"]
+    blob = serialization.msgpack_serialize(raw)
+    with open(state_path, "wb") as f:
+        f.write(blob)
+    with open(os.path.join(d, "meta.json")) as f:
+        meta = json.load(f)
+    # a v3 writer stamped format 3 and knew nothing of topology
+    meta.update(format=3, sha256=hashlib.sha256(blob).hexdigest(),
+                bytes=len(blob))
+    meta.pop("topology", None)
+    with open(os.path.join(d, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    return d
+
+
+if __name__ == "__main__":
+    print(main())

@@ -16,6 +16,13 @@ evaluation fingerprint of the trained model; the parent then restores
 the checkpoint model-only in a plain single-process build and asserts
 the identical fingerprint (SURVEY.md §5(4) + A8).
 
+With ``MP_CHAOS=1`` additionally set, process 1 SIGKILLs itself after
+the collective save and process 0 runs the graftmorph coordinated-
+preemption exit path against the dead peer: announce, bounded barrier
+(must fail, not hang), degraded per-host shard save, and the
+all-shards-or-skip fallback to the newest COMPLETE save
+(docs/RESILIENCE.md §6) — then exits 0.
+
 The jax config setup lives under ``__main__`` so the parent test process
 can import :func:`worker_config` / :func:`eval_fingerprint` without
 mutating its own already-initialized backend.
@@ -126,6 +133,47 @@ def main() -> int:
         # bit-equality against its own single-process restore
         print(f"EVAL {eval_fingerprint(exp, ts.learner.params['agent']):.17g}",
               flush=True)
+
+    if ckpt_dir and os.environ.get("MP_CHAOS") == "1":
+        # graftmorph chaos acceptance (docs/RESILIENCE.md §6): SIGKILL
+        # one of the two gloo hosts, then drive the SURVIVOR through the
+        # driver's coordinated-preemption exit path against the corpse.
+        import signal
+        import time
+
+        from t2omca_tpu.parallel import distributed as dist
+        from t2omca_tpu.utils.checkpoint import (find_checkpoint,
+                                                 save_checkpoint_shards,
+                                                 verify_checkpoint)
+        if jax.process_index() == 1:
+            # the victim: die the hard way — no atexit, no handler, no
+            # goodbye to the coordinator; exactly what a spot-VM reclaim
+            # looks like to the surviving host. The parent must NOT
+            # assert this process's returncode (-SIGKILL by design).
+            os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(1.0)                 # let the SIGKILL actually land
+        t_cut = 48
+        dist.announce_shutdown(t_cut)
+        # the bounded barrier against a dead peer: must fail INSIDE the
+        # timeout instead of hanging (a collective save here would block
+        # forever on the gloo transport — that is the whole point of the
+        # degrade-to-shards protocol)
+        target, ok = dist.negotiate_stop_step(t_cut, timeout_s=3.0)
+        assert not ok, "barrier must degrade against a dead peer"
+        assert target == t_cut
+        # degraded exit: zero collectives — this host's shard only
+        save_checkpoint_shards(ckpt_dir, t_cut, ts)
+        # all-shards-or-skip gate: shard 0-of-2 alone is NOT valid; the
+        # newest RESUMABLE save is the complete collective one at 32
+        assert not verify_checkpoint(os.path.join(ckpt_dir, str(t_cut)))
+        found = find_checkpoint(ckpt_dir)
+        assert found is not None, "completeness gate skipped everything"
+        print(f"CKPT {found[1]}", flush=True)
+        # skip atexit: jax.distributed.shutdown would wait on the dead
+        # peer's never-arriving disconnect. The exit STATUS is the
+        # survivor's contract, not its teardown.
+        sys.stdout.flush()
+        os._exit(0)
     return 0
 
 

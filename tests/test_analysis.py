@@ -431,6 +431,58 @@ def test_gl110_registry_parsed_from_spans_module(tmp_path):
         {"dispatch.bogus", "fetch.bogus", "fetch.train_stats"}
 
 
+# --------------------------------------------------------------- GL112
+
+_GL112_SRC = """
+from flax import serialization
+
+def load(blob, template, raw):
+    params = serialization.msgpack_restore(blob)
+    agent = serialization.from_state_dict(template, raw)
+    return params, agent
+"""
+
+
+def test_gl112_raw_deserialize_in_ckpt_modules():
+    """Both flax deserializers flag in the driver and serve modules —
+    the checkpoint-door contract (docs/ANALYSIS.md GL112)."""
+    for path in ("t2omca_tpu/run.py", "t2omca_tpu/serve/export2.py"):
+        fs = lint_source(_GL112_SRC, path)
+        assert [f.rule for f in fs] == ["GL112", "GL112"], path
+        msgs = " | ".join(f.message for f in fs)
+        assert "msgpack_restore" in msgs
+        assert "from_state_dict" in msgs
+        assert "utils/checkpoint" in msgs
+
+
+def test_gl112_scoped_to_ckpt_globs_and_alias_resolved():
+    # utils/checkpoint.py IS the sanctioned door; library code elsewhere
+    # may deserialize whatever it owns — neither is in CKPT_PATH_GLOBS
+    assert lint_source(_GL112_SRC, "t2omca_tpu/utils/checkpoint.py") == []
+    assert lint_source(_GL112_SRC, "t2omca_tpu/components/foo.py") == []
+    # alias-resolved: `import flax.serialization as ser` still flags,
+    # and an unresolvable receiver falls back to the attribute name
+    src = """
+import flax.serialization as ser
+
+def load(blob, codec):
+    a = ser.msgpack_restore(blob)
+    b = codec().from_state_dict(None, blob)
+    return a, b
+"""
+    fs = lint_source(src, "t2omca_tpu/serve/x.py")
+    assert [f.rule for f in fs] == ["GL112", "GL112"]
+    # a same-named call on a RESOLVABLE non-flax receiver is not a raw
+    # checkpoint load (the fallback only covers opaque receivers)
+    clean = """
+import mylib
+
+def load(blob):
+    return mylib.msgpack_restore(blob)
+"""
+    assert lint_source(clean, "t2omca_tpu/serve/x.py") == []
+
+
 # ---------------------------------------------------------- suppression
 
 def test_inline_suppression_and_skip_file():

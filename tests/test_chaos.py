@@ -128,6 +128,41 @@ def _inject_sigterm():
     resilience.register_fault("driver.iteration", _preempt)
 
 
+def _inject_preempt_barrier_timeout():
+    # graftmorph (docs/RESILIENCE.md §6): preemption whose stop-step
+    # negotiation FAILS (peer died mid-barrier) — the exit must degrade
+    # to the per-host shard save, which on one host is a complete (and
+    # therefore valid, resumable) checkpoint
+    def _trip(t_env, guard, **kw):
+        if guard is not None and t_env >= 24:
+            guard.request("chaos-preempt")
+
+    def _barrier_dies(**kw):
+        raise RuntimeError("chaos: peer died mid-negotiation")
+
+    resilience.register_fault("driver.iteration", _trip)
+    resilience.register_fault("preempt.barrier", _barrier_dies)
+
+
+def _inject_shard_save_crash():
+    # the degraded path's own failure: the barrier dies AND the
+    # fallback shard write dies — the exit must still be orderly and
+    # leave the last cadence save as the resume point
+    def _trip(t_env, guard, **kw):
+        if guard is not None and t_env >= 24:
+            guard.request("chaos-preempt")
+
+    def _barrier_dies(**kw):
+        raise RuntimeError("chaos: peer died mid-negotiation")
+
+    def _shard_dies(**kw):
+        raise RuntimeError("chaos: disk full mid-shard-write")
+
+    resilience.register_fault("driver.iteration", _trip)
+    resilience.register_fault("preempt.barrier", _barrier_dies)
+    resilience.register_fault("checkpoint.shard_save", _shard_dies)
+
+
 #: (name, injector, may_raise) — may_raise names the exception type a
 #: scenario is ALLOWED to kill the run with; resumability must hold
 #: either way.
@@ -139,6 +174,10 @@ SCENARIOS = [
     ("flaky_checkpoint_gather", _inject_flaky_gather, None),
     ("crash_mid_checkpoint", _inject_checkpoint_crash, RuntimeError),
     ("sigterm_preemption", _inject_sigterm, None),
+    ("preempt_barrier_timeout_shard_save",
+     _inject_preempt_barrier_timeout, None),
+    ("shard_save_crash_keeps_cadence_save",
+     _inject_shard_save_crash, None),
 ]
 
 
@@ -184,4 +223,5 @@ def test_chaos_scenarios_cover_every_hook_point():
             for line in inspect.getsource(inject).splitlines()
             if "register_fault(" in line)
     assert {"dispatch.superstep", "dispatch.wait", "collective.gather",
-            "checkpoint.staged", "driver.iteration"} <= covered
+            "checkpoint.staged", "driver.iteration", "preempt.barrier",
+            "checkpoint.shard_save"} <= covered

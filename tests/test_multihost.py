@@ -32,8 +32,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch_workers(extra_env=None):
-    """Start the 2-process worker pair; return their stdouts."""
+def _spawn_workers(extra_env=None):
+    """Start the 2-process worker pair; return the live Popen handles."""
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -51,8 +51,13 @@ def _launch_workers(extra_env=None):
             [sys.executable, os.path.join("tests", "mp_worker.py")],
             cwd=REPO, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True))
+    return procs
+
+
+def _launch_workers(extra_env=None):
+    """Start the 2-process worker pair; return their stdouts."""
     outs = []
-    for p in procs:
+    for p in _spawn_workers(extra_env):
         out, err = p.communicate(timeout=900)
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         outs.append(out)
@@ -103,6 +108,53 @@ def test_two_process_checkpoint_restores_single_process(tmp_path):
     ts = load_learner_state(dirname, exp.init_train_state(0))
     metric = eval_fingerprint(exp, ts.learner.params["agent"])
     np.testing.assert_allclose(metric, evals[0], rtol=0, atol=0)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sigkill_one_host_survivor_exits_resumable(tmp_path):
+    """graftmorph chaos acceptance (ISSUE/docs/RESILIENCE.md §6): SIGKILL
+    one of the two gloo hosts after the complete collective save. The
+    survivor's preemption barrier must fail BOUNDED (not hang on the
+    corpse), degrade to the per-host shard save, skip the resulting
+    incomplete partial via the all-shards-or-skip gate, and exit 0
+    pointing at the newest COMPLETE save — which a fresh SINGLE-process
+    build (2 hosts x 4 devices -> 1 host) then restores elastically to
+    the identical eval fingerprint."""
+    from mp_worker import eval_fingerprint, worker_config
+    from t2omca_tpu.run import Experiment
+    from t2omca_tpu.utils.checkpoint import (find_checkpoint,
+                                             restore_elastic,
+                                             verify_checkpoint)
+
+    ckpt_root = str(tmp_path / "chaos_ckpt")
+    procs = _spawn_workers({"MP_CKPT_DIR": ckpt_root, "MP_CHAOS": "1"})
+    out0, err0 = procs[0].communicate(timeout=900)
+    # the victim died by SIGKILL (rc is -9 by design — never asserted);
+    # reap it so no zombie outlives the test
+    procs[1].communicate(timeout=900)
+    assert procs[0].returncode == 0, f"survivor failed:\n{err0[-3000:]}"
+
+    # the survivor resolved the COMPLETE collective save at 32, not its
+    # own incomplete 1-of-2 partial at 48
+    ckpt_lines = [l for l in out0.splitlines() if l.startswith("CKPT ")]
+    assert ckpt_lines == ["CKPT 32"], out0
+
+    # the degraded shard landed on disk but fails the completeness gate
+    part = os.path.join(ckpt_root, "48")
+    assert os.path.exists(os.path.join(part, "shard.0-of-2.msgpack"))
+    assert not verify_checkpoint(part)
+    found = find_checkpoint(ckpt_root)
+    assert found is not None and found[1] == 32
+    assert verify_checkpoint(found[0])
+
+    # single-process elastic restore of the survivor-selected save: the
+    # replicated model evaluates bit-identically to the 2-process run
+    exp = Experiment.build(worker_config())
+    ts = restore_elastic(found[0], exp.init_train_state(0))
+    metric = eval_fingerprint(exp, ts.learner.params["agent"])
+    np.testing.assert_allclose(metric, _parse([out0], "EVAL")[0],
+                               rtol=0, atol=0)
 
 
 # ---------------------------------------------------------------------------
