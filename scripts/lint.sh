@@ -6,6 +6,11 @@
 # no jax import, no backend startup — so it runs in front of the tier-1
 # pytest batch (scripts/t1.sh) at negligible cost.
 #
+# `bash scripts/lint.sh --threads` runs the graftrace concurrency
+# audit instead (GT1xx: thread topology + lock discipline over the
+# host threads) — still pure AST/jax-free, same baseline file and the
+# same 0/1/2 exit contract; t1.sh runs it as its own prelude.
+#
 # The same CLI also hosts the two compiled audit levels — `--programs`
 # (graftprog: per-program HLO budgets/fingerprints, GP2xx/GP3xx) and
 # `--comms` (graftshard: collective census + sharding rules, GP4xx) —

@@ -6,6 +6,8 @@
 #
 # Prelude 1 (graftlint, ~1 s): AST lint over the package; any NEW
 # finding fails the gate before backend startup.
+# Prelude 1a (graftrace, ~1 s): the concurrency sibling — GT1xx
+# thread-topology / lock-discipline audit, same ratchet contract.
 # Prelude 2 (graftprog, ~45 s budgeted at 240 s for a loaded box):
 # lower/compile the registered hot programs and ratchet their
 # donation/dtype/constant rules + HLO budgets + fingerprints against
@@ -18,6 +20,12 @@ set -o pipefail
 cd "$(dirname "$0")/.." || exit 2
 bash scripts/lint.sh 2>&1 | tee /tmp/_t1_lint.log; lrc=${PIPESTATUS[0]}
 [ $lrc -ne 0 ] && { [ $lrc -eq 1 ] && echo "graftlint gate failed (new findings above; docs/ANALYSIS.md)" || echo "graftlint internal error (exit $lrc; docs/ANALYSIS.md)"; exit 1; }
+# Prelude 1a (graftrace, ~1 s, jax-free): thread-topology &
+# lock-discipline audit (GT1xx) over the host concurrency plane —
+# watchdog/fleet/sebulba/pulse threads. Same ratchet file, same
+# contract: any NEW finding fails the gate before backend startup.
+timeout -k 5 60 bash scripts/lint.sh --threads 2>&1 | tee /tmp/_t1_threads.log; trc=${PIPESTATUS[0]}
+[ $trc -ne 0 ] && { [ $trc -eq 1 ] && echo "graftrace gate failed (new findings above; docs/ANALYSIS.md)" || echo "graftrace internal error (exit $trc; docs/ANALYSIS.md)"; exit 1; }
 # Prelude 1b (obs timeline, ~1 s, jax-free): the longitudinal BENCH
 # trajectory CLI over the checked-in records must exit 0 and render the
 # r03+ wedged partials as wedged rows — the post-mortem tool must not

@@ -7,6 +7,9 @@ Two halves (docs/ANALYSIS.md):
   hot-path host syncs, donation aliasing, dead imports), ratcheted by
   the checked-in ``baseline.json``. CLI: ``python -m t2omca_tpu.analysis``
   (``scripts/lint.sh``; runs at the top of the tier-1 gate).
+  ``graftrace`` (GT1xx, ``--threads``) is its concurrency sibling:
+  thread-topology discovery + lock-discipline audit over the host
+  threads, sharing the same baseline file and exit-code contract.
 * ``guards`` — runtime context managers tests assert under:
   ``compile_budget(n)`` pins a program to n XLA compiles,
   ``no_transfer()`` turns implicit host transfers into errors.
@@ -19,10 +22,12 @@ front of every test batch), so guard names resolve lazily via module
 from __future__ import annotations
 
 from .baseline import (DEFAULT_BASELINE, DEFAULT_PROGRAMS, diff_baseline,
-                       load_baseline, load_programs, save_baseline,
-                       save_programs)
+                       filter_family, load_baseline, load_programs,
+                       save_baseline, save_programs)
 from .graftlint import (HOT_PATH_GLOBS, RULES, Finding, lint_file,
                         lint_package, lint_source)
+from .graftrace import (GT_RULES, trace_file, trace_package,
+                        trace_source)
 
 _GUARD_NAMES = ("compile_budget", "no_transfer", "CompileBudgetExceeded",
                 "CompileEvents")
@@ -42,9 +47,12 @@ _PROG_NAMES = {
 
 __all__ = [
     "DEFAULT_BASELINE", "DEFAULT_PROGRAMS", "diff_baseline",
-    "load_baseline", "load_programs", "save_baseline", "save_programs",
+    "filter_family", "load_baseline", "load_programs", "save_baseline",
+    "save_programs",
     "HOT_PATH_GLOBS", "RULES", "Finding", "lint_file", "lint_package",
-    "lint_source", *_GUARD_NAMES, *sorted(_PROG_NAMES),
+    "lint_source",
+    "GT_RULES", "trace_file", "trace_package", "trace_source",
+    *_GUARD_NAMES, *sorted(_PROG_NAMES),
 ]
 
 

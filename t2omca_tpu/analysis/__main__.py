@@ -1,5 +1,5 @@
-"""``python -m t2omca_tpu.analysis`` — the graftlint/graftprog/
-graftshard CLI.
+"""``python -m t2omca_tpu.analysis`` — the graftlint/graftrace/
+graftprog/graftshard CLI.
 
 Exit codes (the contract ``scripts/lint.sh``, ``scripts/t1.sh`` and the
 tier-1 gate rely on): 0 = no new findings (baselined accepted findings
@@ -31,9 +31,10 @@ from collections import Counter
 from pathlib import Path
 
 from .baseline import (DEFAULT_BASELINE, DEFAULT_PROGRAMS, diff_baseline,
-                       load_baseline, load_programs, save_baseline,
-                       save_comms, save_programs)
+                       filter_family, load_baseline, load_programs,
+                       save_baseline, save_comms, save_programs)
 from .graftlint import RULES, lint_package
+from .graftrace import GT_RULES, trace_package
 
 
 def _pin_cpu_platform() -> None:
@@ -292,6 +293,49 @@ def _programs_main(args) -> int:
     return 1 if findings else 0
 
 
+def _ratchet_main(args, tool: str, family: str, run, root) -> int:
+    """Shared source-ratchet leg: lint (GL) and threads (GT) differ only
+    in the analyzer and the baseline family they own. ``run(root,
+    paths)`` -> findings; exit 0/1/2 per the CLI contract."""
+    try:
+        findings = run(root, args.paths or None)
+    except (OSError, SyntaxError, ValueError) as e:
+        print(f"{tool}: error: {e}", file=sys.stderr)
+        return 2
+
+    try:
+        full = {} if args.no_baseline else load_baseline(args.baseline)
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        print(f"{tool}: error: unreadable baseline {args.baseline}: "
+              f"{e}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        # scoped rewrite: the OTHER family's entries are carried verbatim
+        save_baseline(args.baseline, findings, full, family=family)
+        print(f"{tool}: wrote {len(set(f.key() for f in findings))} "
+              f"accepted keys to {args.baseline}")
+        return 0
+
+    baseline = filter_family(full, family)
+    new, stale = diff_baseline(findings, baseline)
+    for f in new:
+        print(f.format())
+        print(f"    {f.code}")
+    for key in stale:
+        rule, path, code = key
+        print(f"{tool}: warning: stale baseline entry {rule} {path}: "
+              f"{code!r} (fixed? run --write-baseline to tighten)",
+              file=sys.stderr)
+    n_base = len(findings) - len(new)
+    per_rule = Counter(f.rule for f in new)
+    summary = ", ".join(f"{r}x{c}" if c > 1 else r
+                        for r, c in sorted(per_rule.items()))
+    print(f"{tool}: {len(findings)} findings "
+          f"({n_base} baselined, {len(new)} new"
+          + (f": {summary}" if summary else "") + ")")
+    return 1 if new else 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m t2omca_tpu.analysis",
@@ -314,6 +358,11 @@ def main(argv=None) -> int:
         "--write-baseline", action="store_true",
         help="accept the current finding set as the baseline (keeps "
              "existing justifications; new keys get a TODO marker)")
+    parser.add_argument(
+        "--threads", action="store_true",
+        help="run the graftrace thread-topology & lock-discipline "
+             "audit (GT1xx) instead of the tracing lint — same "
+             "baseline file, same exit-code contract, still jax-free")
     parser.add_argument(
         "--list-rules", action="store_true",
         help="print the rule catalog and exit")
@@ -353,7 +402,7 @@ def main(argv=None) -> int:
     if args.list_rules:
         from .graftprog import GP_RULES
         from .graftshard import GP4_RULES
-        for rule, summary in sorted({**RULES, **GP_RULES,
+        for rule, summary in sorted({**RULES, **GT_RULES, **GP_RULES,
                                      **GP4_RULES}.items()):
             print(f"{rule}  {summary}")
         return 0
@@ -368,41 +417,10 @@ def main(argv=None) -> int:
         return _programs_main(args)
 
     root = args.root or Path(__file__).resolve().parents[2]
-    try:
-        findings = lint_package(root, args.paths or None)
-    except (OSError, SyntaxError, ValueError) as e:
-        print(f"graftlint: error: {e}", file=sys.stderr)
-        return 2
-
-    try:
-        baseline = {} if args.no_baseline else load_baseline(args.baseline)
-    except (OSError, ValueError, KeyError, TypeError) as e:
-        print(f"graftlint: error: unreadable baseline {args.baseline}: "
-              f"{e}", file=sys.stderr)
-        return 2
-    if args.write_baseline:
-        save_baseline(args.baseline, findings, baseline)
-        print(f"graftlint: wrote {len(set(f.key() for f in findings))} "
-              f"accepted keys to {args.baseline}")
-        return 0
-
-    new, stale = diff_baseline(findings, baseline)
-    for f in new:
-        print(f.format())
-        print(f"    {f.code}")
-    for key in stale:
-        rule, path, code = key
-        print(f"graftlint: warning: stale baseline entry {rule} {path}: "
-              f"{code!r} (fixed? run --write-baseline to tighten)",
-              file=sys.stderr)
-    n_base = len(findings) - len(new)
-    per_rule = Counter(f.rule for f in new)
-    summary = ", ".join(f"{r}x{c}" if c > 1 else r
-                        for r, c in sorted(per_rule.items()))
-    print(f"graftlint: {len(findings)} findings "
-          f"({n_base} baselined, {len(new)} new"
-          + (f": {summary}" if summary else "") + ")")
-    return 1 if new else 0
+    if args.threads:
+        return _ratchet_main(args, "graftrace", "GT", trace_package,
+                             root)
+    return _ratchet_main(args, "graftlint", "GL", lint_package, root)
 
 
 if __name__ == "__main__":

@@ -13,6 +13,13 @@ count per key — line numbers churn with every unrelated edit, quoted
 code text doesn't. When a file accrues *more* occurrences of an already
 -baselined line (say a second copy-pasted ``device_get``), the excess
 occurrences count as new.
+
+The file is shared by two rule families: graftlint (``GL``) and
+graftrace (``GT``). Each CLI leg diffs only its own family
+(``filter_family``) — otherwise lint would report every GT entry as
+stale and vice versa — and a ``--write-baseline`` from one leg carries
+the other family's entries verbatim (the ``family=`` parameter of
+``save_baseline``) instead of erasing them.
 """
 
 from __future__ import annotations
@@ -54,11 +61,24 @@ def load_baseline(path: Path = DEFAULT_BASELINE) -> Dict[Key, dict]:
     return out
 
 
+def filter_family(baseline: Dict[Key, dict],
+                  family: str) -> Dict[Key, dict]:
+    """Restrict a loaded baseline to one rule family by id prefix
+    (``"GL"`` for graftlint, ``"GT"`` for graftrace)."""
+    return {k: v for k, v in baseline.items() if k[0].startswith(family)}
+
+
 def save_baseline(path: Path, findings: Sequence[Finding],
-                  old: Dict[Key, dict] | None = None) -> None:
+                  old: Dict[Key, dict] | None = None,
+                  family: str | None = None) -> None:
     """Write the current finding set as the new baseline, carrying over
     justifications for keys that survive; new keys get a TODO marker so
-    review can't silently skip them."""
+    review can't silently skip them.
+
+    With ``family`` set (a rule-id prefix), the rewrite is scoped to
+    that family: entries of OTHER families in ``old`` are carried
+    verbatim — a ``--threads --write-baseline`` must never erase the
+    lint entries sharing the file, and vice versa."""
     old = old or {}
     counts = Counter(f.key() for f in findings)
     entries = []
@@ -70,6 +90,16 @@ def save_baseline(path: Path, findings: Sequence[Finding],
             "justification": old.get(key, {}).get(
                 "justification") or "TODO: justify or fix",
         })
+    if family is not None:
+        for key in sorted(old):
+            if not key[0].startswith(family):
+                rule, fpath, code = key
+                entries.append({
+                    "rule": rule, "path": fpath, "code": code,
+                    "count": old[key]["count"],
+                    "justification": old[key].get("justification", ""),
+                })
+        entries.sort(key=lambda e: (e["rule"], e["path"], e["code"]))
     payload = {"version": BASELINE_VERSION, "findings": entries}
     Path(path).write_text(json.dumps(payload, indent=2) + "\n")
 

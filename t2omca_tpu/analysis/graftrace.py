@@ -1,0 +1,1237 @@
+"""graftrace — static thread-topology & lock-discipline auditor (GT1xx).
+
+graftlint/graftprog/graftshard ratchet the traced/compiled plane; the
+host concurrency plane that keeps those programs alive — the watchdog
+monitor/on-stall/ExitDeadline threads, Sebulba's decoupled actor thread
+(Podracer, PAPERS.md), graftfleet's engine/supervisor threads behind one
+admission queue, the pulse HTTP scrape server, TraceController — had no
+gate, and every thread-safety bug so far (the unsynchronized
+``Logger.stats`` race, SpanRecorder completion-keys-outside-the-lock,
+the shared-Watchdog-stamp gotcha, the unbounded ``save_lock.acquire()``
+exit wedge) was found by hand in review passes. graftrace is the fourth
+static plane and the first that guards the robustness layer itself:
+
+1. **Thread topology** — spawn sites (``threading.Thread(target=...)``,
+   ``threading.Timer``, ``Executor.submit``, ``*HTTPRequestHandler``
+   subclasses) seed thread *roles*; roles propagate through the
+   module-local call graph (``f()`` / ``self.m()``) to a fixpoint, so
+   a helper called from both the main thread and a worker carries both
+   roles. Everything not reachable from a spawn site runs as ``main``.
+2. **Shared-state census** — ``self.<attr>`` accesses (incl. through
+   class-annotated parameters/locals), module globals written via
+   ``global``, and closure variables shared with a spawned nested
+   function. Each access site records its role set, whether it writes,
+   and the set of locks held (``with lock:`` blocks, statement-level
+   ``acquire``/``release``, and ``if lock.acquire(timeout=...)``
+   guards).
+3. **Lock discipline** over the census (the GT rules below).
+
+========  ==============================================================
+GT101     Shared state written from one role and accessed from another
+          with NO lock at any site: the ``Logger.stats`` race class.
+GT102     Bare ``lock.acquire()`` without ``timeout=`` /
+          ``blocking=False`` in a threaded module: a stuck holder
+          wedges the thread with no watchdog escape — the PR 4
+          ``save_lock`` exit wedge, package-wide and role-aware
+          (GL111 covers only LOCK_PATH_GLOBS).
+GT103     Mixed discipline on one attribute: some sites hold a lock,
+          others don't (or hold a different one) — the lock protects
+          nothing (SpanRecorder completion-keys class).
+GT104     Lock-ordering cycle: somewhere ``A`` is held while taking
+          ``B`` and elsewhere ``B`` is held while taking ``A`` — the
+          classic ABBA deadlock, detected on the acquisition graph.
+GT105     One ``Watchdog`` instance stamped (``stamp``/``clear``/
+          ``watch``) from >= 2 roles: stamps interleave and a stall in
+          one thread is masked by the other's heartbeat — each thread
+          needs its own watchdog (the Sebulba shared-stamp gotcha).
+GT106     Blocking/device-facing call (``device_get``,
+          ``block_until_ready``, unbounded ``join()``/``wait()``,
+          socket ops, ``time.sleep``) while holding a lock that
+          another role contends: every contender stalls behind the
+          device/socket, watchdogs can't preempt a held lock.
+========  ==============================================================
+
+Scope and honesty about limits: analysis is **per module** — a thread
+spawned in one module running a function from another is invisible, as
+is state shared through an object handed across modules. Call-graph
+propagation resolves ``f()`` against the lexical scope chain and
+``self.m()`` against the enclosing class; calls through arbitrary
+attributes (``self.hub.gauge(...)``) are not tracked, so roles are an
+under-approximation and lock inference (``with self._lock``) is
+name-based. Writes in ``__init__``/``__post_init__`` and — for closure
+state — lexically before the first spawn in the owning function are
+treated as pre-thread (happens-before the spawn) and exempt.
+False positives are expected and cheap: suppress a line with
+``# graftrace: disable=GT1xx`` (``# graftrace: skip-file`` at the top
+skips a module) or accept it into ``analysis/baseline.json`` with a
+justification — GT findings share the graftlint ratchet file, keyed by
+(rule, path, code-line text) so unrelated edits don't churn entries.
+CLI: ``python -m t2omca_tpu.analysis --threads`` (jax-free, < 5 s;
+``scripts/lint.sh --threads``; a tier-1 prelude in ``scripts/t1.sh``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .graftlint import Finding, _dotted
+
+#: rule id -> one-line summary (the full catalog lives in docs/ANALYSIS.md)
+GT_RULES: Dict[str, str] = {
+    "GT101": "unlocked cross-thread write to shared state",
+    "GT102": "bare lock acquire() without timeout in a threaded module",
+    "GT103": "mixed locked/unlocked access to one shared attribute",
+    "GT104": "lock-ordering cycle across the acquisition graph",
+    "GT105": "one Watchdog instance stamped from >= 2 thread roles",
+    "GT106": "blocking call while holding a lock another role contends",
+}
+
+_SUPPRESS_RE = re.compile(r"#\s*graftrace:\s*disable(?:=(?P<rules>\S+))?")
+_SKIP_FILE_RE = re.compile(r"#\s*graftrace:\s*skip-file")
+
+#: constructors whose result is a lock-like object (trackable identity;
+#: ``with``/``acquire`` on one participates in the discipline checks)
+_LOCK_FACTORIES = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+})
+#: constructors whose result is internally synchronized — excluded from
+#: the shared-state census (deque append/popleft are CPython-atomic and
+#: used as such throughout the repo; Thread handles are control-plane)
+_SAFE_FACTORIES = frozenset({
+    "threading.Event", "threading.Barrier", "threading.local",
+    "threading.Thread", "threading.Timer",
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue", "collections.deque",
+})
+#: constructors that build a Watchdog (GT105 identity tracking). The
+#: tail-match also catches ``from ..utils.watchdog import Watchdog``
+#: (relative imports resolve to a bare name).
+_WATCHDOG_TAILS = frozenset({"Watchdog"})
+#: Watchdog methods that stamp the shared liveness channel
+_STAMP_METHODS = frozenset({"stamp", "clear", "watch"})
+
+#: method names whose call mutates the receiver (census write markers)
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "remove", "discard", "add", "clear", "update",
+    "setdefault", "sort", "reverse", "put", "put_nowait",
+})
+#: methods whose writes are pre-thread setup: accesses here are exempt
+#: from GT101/GT103 (object construction happens-before the spawn)
+_INIT_METHODS = frozenset({"__init__", "__post_init__", "__new__"})
+#: handler base classes whose methods run on server threads
+_HANDLER_BASES = frozenset({
+    "BaseHTTPRequestHandler", "SimpleHTTPRequestHandler",
+    "CGIHTTPRequestHandler", "BaseRequestHandler",
+    "StreamRequestHandler", "DatagramRequestHandler",
+})
+#: always-blocking calls for GT106 (canonical dotted names)
+_BLOCKING_NAMES = frozenset({
+    "jax.device_get", "jax.block_until_ready", "time.sleep",
+})
+#: attribute calls that block the calling thread: socket/file ops are
+#: unconditional; join/wait only when unbounded (no timeout)
+_BLOCKING_SOCKET_ATTRS = frozenset({
+    "recv", "recvfrom", "accept", "connect", "sendall", "sendto",
+    "serve_forever", "handle_request", "getconn", "select",
+})
+_BLOCKING_IF_UNBOUNDED = frozenset({"join", "wait"})
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    """``acquire``/``join``/``wait`` call carries a bound: a ``timeout=``
+    kw, ``blocking=False``, or a positional argument (the timeout for
+    join/wait, the blocking flag for acquire — ``acquire(False)``)."""
+    if any(kw.arg in ("timeout", "blocking") for kw in call.keywords):
+        return True
+    return bool(call.args)
+
+
+def _is_bounded_acquire(call: ast.Call) -> bool:
+    """GT102 boundedness: ``acquire(timeout=...)``, ``acquire(
+    blocking=False)`` or positional ``acquire(False)`` — mirrors
+    GL111's definition so the two rules never disagree on a site."""
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    for kw in call.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and call.args[0].value is False:
+        return True
+    return False
+
+
+# --------------------------------------------------------------- structure
+
+@dataclasses.dataclass
+class _FnInfo:
+    """One function-like scope (def / async def / spawned lambda)."""
+
+    node: ast.AST
+    qualname: str
+    name: str
+    cls: Optional[str]                  # enclosing class name, if a method
+    parent: Optional[int]               # id(node) of the enclosing function
+    bound: Set[str] = dataclasses.field(default_factory=set)
+    nonlocals: Set[str] = dataclasses.field(default_factory=set)
+    globals_decl: Set[str] = dataclasses.field(default_factory=set)
+    children: Dict[str, int] = dataclasses.field(default_factory=dict)
+    roles: Set[str] = dataclasses.field(default_factory=set)
+    spawn_target: bool = False
+    #: names this scope shares with a nested function IT spawns
+    shared: Set[str] = dataclasses.field(default_factory=set)
+    #: lexically first spawn statement line in this scope (None = none):
+    #: closure accesses before it happen-before the thread exists
+    first_spawn_line: Optional[int] = None
+    #: local name -> lock id (``l = threading.Lock()`` at this scope)
+    local_locks: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: local name -> watchdog id
+    local_wds: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: local names bound to internally-synchronized objects
+    local_safe: Set[str] = dataclasses.field(default_factory=set)
+    #: local/param name -> module class name (annotation or constructor)
+    typed: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class _Access:
+    """One shared-state access site."""
+
+    key: Tuple                           # census key (kind, owner, name)
+    write: bool
+    init: bool                           # pre-thread (exempt) site
+    roles: frozenset
+    held: frozenset                      # lock ids held at the site
+    node: ast.AST
+    fn: str                              # qualname, for messages
+
+
+@dataclasses.dataclass
+class _Acquire:
+    """One lock acquisition event (``with`` or ``.acquire``)."""
+
+    lock: str
+    roles: frozenset
+    held: frozenset                      # locks already held (GT104 edges)
+    node: ast.AST
+
+
+@dataclasses.dataclass
+class _Blocking:
+    """One blocking call made while >= 1 lock was held."""
+
+    what: str
+    roles: frozenset
+    held: frozenset
+    node: ast.AST
+
+
+class _ModuleTracer:
+    """One parsed module: topology discovery, census, discipline rules.
+    Produces a deduplicated, line-sorted :class:`Finding` list."""
+
+    def __init__(self, src: str, path: str):
+        self.src = src
+        self.path = path
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+        #: local alias -> canonical dotted path (same scheme as graftlint)
+        self.modmap: Dict[str, str] = {}
+        #: id(fn node) -> info
+        self.fns: Dict[int, _FnInfo] = {}
+        #: class name -> {method name -> fn id}
+        self.methods: Dict[str, Dict[str, int]] = {}
+        #: module-level def name -> fn id
+        self.top_fns: Dict[str, int] = {}
+        self.classes: Set[str] = set()
+        self.handler_classes: Set[str] = set()
+        #: (class, attr) -> lock id  /  safe-attr set  /  watchdog ids
+        self.lock_attrs: Dict[Tuple[str, str], str] = {}
+        self.safe_attrs: Set[Tuple[str, str]] = set()
+        self.wd_attrs: Dict[Tuple[str, str], str] = {}
+        #: module-global name -> lock / watchdog id, safe set
+        self.global_locks: Dict[str, str] = {}
+        self.global_wds: Dict[str, str] = {}
+        self.global_safe: Set[str] = set()
+        #: globals written via a ``global`` declaration somewhere
+        self.written_globals: Set[str] = set()
+        #: call edges: caller fn id (None = module level) -> callee ids
+        self.calls: Dict[Optional[int], Set[int]] = {}
+        #: recorded events
+        self.accesses: List[_Access] = []
+        self.acquires: List[_Acquire] = []
+        self.blockings: List[_Blocking] = []
+        self.findings: Set[Finding] = set()
+        self.has_spawns = False
+        self._collect_imports()
+
+    # ------------------------------------------------------------ aliases
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.modmap[a.asname] = a.name
+                    else:
+                        root = a.name.split(".")[0]
+                        self.modmap[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                if node.module is None or node.level:
+                    continue            # relative imports: package-internal
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.modmap[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+
+    def canonical(self, node: ast.AST) -> Optional[str]:
+        dotted = _dotted(node)
+        if dotted is None:
+            return None
+        root, _, rest = dotted.partition(".")
+        base = self.modmap.get(root)
+        if base is None:
+            return dotted
+        return f"{base}.{rest}" if rest else base
+
+    # ---------------------------------------------------------- emission
+
+    def emit(self, node: ast.AST, rule: str, message: str) -> None:
+        line, col = node.lineno, node.col_offset + 1
+        code = (self.lines[line - 1].strip()
+                if 0 < line <= len(self.lines) else "")
+        m = _SUPPRESS_RE.search(self.lines[line - 1]) \
+            if 0 < line <= len(self.lines) else None
+        if m:
+            named = m.group("rules")
+            if named is None or rule in {r.strip().upper()
+                                         for r in named.split(",")}:
+                return
+        self.findings.add(Finding(path=self.path, line=line, col=col,
+                                  rule=rule, message=message, code=code))
+
+    # --------------------------------------------------- pass 1: structure
+
+    def build(self) -> None:
+        """Scope tree, class/method tables, lock/safe/watchdog identity,
+        spawn sites and role seeding + propagation."""
+        self._walk_structure(self.tree, parent=None, cls=None)
+        self._collect_identities()
+        self._collect_spawns()
+        self._collect_calls()
+        self._propagate_roles()
+        self._collect_closure_shared()
+
+    def _walk_structure(self, node: ast.AST, parent: Optional[int],
+                        cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if parent is not None:
+                    qual = f"{self.fns[parent].qualname}.{child.name}"
+                elif cls is not None:
+                    qual = f"{cls}.{child.name}"
+                else:
+                    qual = child.name
+                info = _FnInfo(node=child, qualname=qual, name=child.name,
+                               cls=cls, parent=parent)
+                a = child.args
+                for p in (a.posonlyargs + a.args + a.kwonlyargs):
+                    info.bound.add(p.arg)
+                    ann = p.annotation
+                    tname = None
+                    if isinstance(ann, ast.Name):
+                        tname = ann.id
+                    elif isinstance(ann, ast.Constant) and \
+                            isinstance(ann.value, str):
+                        tname = ann.value.strip("'\"")
+                    if tname:
+                        info.typed[p.arg] = tname
+                for extra in (a.vararg, a.kwarg):
+                    if extra is not None:
+                        info.bound.add(extra.arg)
+                self.fns[id(child)] = info
+                if parent is not None:
+                    self.fns[parent].children[child.name] = id(child)
+                    self.fns[parent].bound.add(child.name)
+                elif cls is not None:
+                    self.methods.setdefault(cls, {})[child.name] = \
+                        id(child)
+                else:
+                    self.top_fns[child.name] = id(child)
+                # class bodies don't form closure scopes: a method's
+                # enclosing function scope skips the class
+                self._walk_structure(child, parent=id(child), cls=cls)
+                continue
+            if isinstance(child, ast.ClassDef):
+                self.classes.add(child.name)
+                for base in child.bases:
+                    d = _dotted(base) or ""
+                    if d.rsplit(".", 1)[-1] in _HANDLER_BASES:
+                        self.handler_classes.add(child.name)
+                self._walk_structure(child, parent=parent,
+                                     cls=child.name)
+                continue
+            if isinstance(child, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                                  ast.For, ast.With, ast.AsyncWith)):
+                if parent is not None:
+                    self._bind_targets(child, self.fns[parent])
+            if isinstance(child, (ast.Global, ast.Nonlocal)) and \
+                    parent is not None:
+                info = self.fns[parent]
+                if isinstance(child, ast.Global):
+                    info.globals_decl.update(child.names)
+                    self.written_globals.update(child.names)
+                else:
+                    info.nonlocals.update(child.names)
+            self._walk_structure(child, parent=parent, cls=cls)
+
+    @staticmethod
+    def _bind_targets(stmt: ast.AST, info: _FnInfo) -> None:
+        targets: List[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.For):
+            targets = [stmt.target]
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            targets = [i.optional_vars for i in stmt.items
+                       if i.optional_vars is not None]
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    info.bound.add(n.id)
+
+    # ----------------------------------------------- identity discovery
+
+    def _collect_identities(self) -> None:
+        """Lock / safe / watchdog / class-typed bindings, all scopes."""
+
+        def classify(value: ast.expr) -> Tuple[Optional[str], str]:
+            """-> (kind, detail): kind in lock/safe/wd/class/None."""
+            if not isinstance(value, ast.Call):
+                return None, ""
+            name = self.canonical(value.func)
+            if name in _LOCK_FACTORIES:
+                return "lock", name
+            if name in _SAFE_FACTORIES:
+                return "safe", name
+            tail = (name or "").rsplit(".", 1)[-1]
+            if tail in _WATCHDOG_TAILS:
+                return "wd", tail
+            if name in self.classes:
+                return "class", name
+            return None, ""
+
+        for scope_id, stmts in self._iter_scopes():
+            info = self.fns.get(scope_id) if scope_id is not None else None
+            for stmt in stmts:
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = stmt.value
+                if value is None:
+                    continue
+                kind, detail = classify(value)
+                if kind is None:
+                    continue
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        if info is None:            # module scope
+                            if kind == "lock":
+                                self.global_locks[t.id] = t.id
+                            elif kind == "safe":
+                                self.global_safe.add(t.id)
+                            elif kind == "wd":
+                                self.global_wds[t.id] = t.id
+                        else:
+                            lid = f"{info.qualname}.{t.id}"
+                            if kind == "lock":
+                                info.local_locks[t.id] = lid
+                            elif kind == "safe":
+                                info.local_safe.add(t.id)
+                            elif kind == "wd":
+                                info.local_wds[t.id] = lid
+                            elif kind == "class":
+                                info.typed[t.id] = detail
+                    elif isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self" and info is not None \
+                            and info.cls is not None:
+                        key = (info.cls, t.attr)
+                        aid = f"{info.cls}.{t.attr}"
+                        if kind == "lock":
+                            self.lock_attrs[key] = aid
+                        elif kind == "safe":
+                            self.safe_attrs.add(key)
+                        elif kind == "wd":
+                            self.wd_attrs[key] = aid
+
+    def _iter_scopes(self):
+        """(scope id | None for module, its direct statement list) —
+        statement lists include nested compound bodies but stop at
+        nested function/class boundaries for binding attribution."""
+
+        def stmts_of(body, acc):
+            for s in body:
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                    continue
+                acc.append(s)
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(s, attr, None)
+                    if sub:
+                        stmts_of(sub, acc)
+                for h in getattr(s, "handlers", []):
+                    stmts_of(h.body, acc)
+            return acc
+
+        yield None, stmts_of(list(self.tree.body), [])
+        for fid, info in self.fns.items():
+            body = getattr(info.node, "body", None)
+            if isinstance(body, list):
+                yield fid, stmts_of(list(body), [])
+            # spawned lambdas have an expression body — no statements
+
+    # ------------------------------------------------------- spawn sites
+
+    def _enclosing_fn(self, node: ast.AST) -> Optional[int]:
+        """fn id whose body lexically contains ``node`` (None = module
+        level). Precomputed containment map, built on first use."""
+        if not hasattr(self, "_owner"):
+            owner: Dict[int, Optional[int]] = {}
+
+            def walk(n: ast.AST, fid: Optional[int]) -> None:
+                for c in ast.iter_child_nodes(n):
+                    nid = id(c) if id(c) in self.fns else fid
+                    owner[id(c)] = fid
+                    walk(c, nid)
+
+            walk(self.tree, None)
+            self._owner = owner
+        return self._owner.get(id(node))
+
+    def _resolve_fn_name(self, name: str,
+                         from_fn: Optional[int]) -> Optional[int]:
+        """Lexical resolution of a bare function name: nested defs of
+        enclosing scopes first, then module-level defs."""
+        fid = from_fn
+        while fid is not None:
+            info = self.fns[fid]
+            if name in info.children:
+                return info.children[name]
+            fid = info.parent
+        return self.top_fns.get(name)
+
+    def _resolve_target(self, expr: ast.expr,
+                        site_fn: Optional[int]) -> Tuple[Optional[int],
+                                                         str]:
+        """Spawn-target expression -> (fn id | None, role name)."""
+        if isinstance(expr, ast.Name):
+            fid = self._resolve_fn_name(expr.id, site_fn)
+            return fid, expr.id.lstrip("_") or expr.id
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and \
+                    expr.value.id == "self" and site_fn is not None:
+                cls = self.fns[site_fn].cls
+                if cls is not None:
+                    fid = self.methods.get(cls, {}).get(expr.attr)
+                    return fid, expr.attr.lstrip("_") or expr.attr
+            return None, expr.attr.lstrip("_") or expr.attr
+        if isinstance(expr, ast.Lambda):
+            # synthesize a scope for the lambda body so its accesses
+            # are attributed to the spawned role, not the spawner
+            site = self.fns.get(site_fn) if site_fn is not None else None
+            qual = (f"{site.qualname}.<lambda>" if site is not None
+                    else "<lambda>")
+            info = _FnInfo(node=expr, qualname=qual, name="<lambda>",
+                           cls=site.cls if site is not None else None,
+                           parent=site_fn)
+            for p in (expr.args.posonlyargs + expr.args.args
+                      + expr.args.kwonlyargs):
+                info.bound.add(p.arg)
+            self.fns[id(expr)] = info
+            if hasattr(self, "_owner"):
+                del self._owner        # containment map must see the
+            return id(expr), "lambda"  # new scope on next lookup
+        return None, "thread"
+
+    def _collect_spawns(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self.canonical(node.func)
+            target: Optional[ast.expr] = None
+            if name in ("threading.Thread", "threading.Timer"):
+                for kw in node.keywords:
+                    if kw.arg in ("target", "function"):
+                        target = kw.value
+                if target is None and name == "threading.Timer" and \
+                        len(node.args) >= 2:
+                    target = node.args[1]
+                if target is None:
+                    for a in node.args:     # Thread(target=...) is the
+                        if not isinstance(a, ast.Constant):  # repo idiom,
+                            target = a      # positional is a fallback
+                            break
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "submit" and node.args:
+                target = node.args[0]
+            if target is None:
+                continue
+            self.has_spawns = True
+            site_fn = self._enclosing_fn(node)
+            fid, role = self._resolve_target(target, site_fn)
+            if fid is not None:
+                info = self.fns[fid]
+                info.roles.add(role)
+                info.spawn_target = True
+            # happens-before marker: the spawn's lexical line, on the
+            # spawning scope AND every enclosing scope — straight-line
+            # setup above the spawn point happens-before the thread
+            # exists even when the spawn lives in a nested helper
+            fid_up: Optional[int] = site_fn
+            while fid_up is not None:
+                site = self.fns[fid_up]
+                if site.first_spawn_line is None or \
+                        node.lineno < site.first_spawn_line:
+                    site.first_spawn_line = node.lineno
+                fid_up = site.parent
+        # HTTP handler classes: every method runs on a server thread
+        for cls in self.handler_classes:
+            self.has_spawns = True
+            for fid in self.methods.get(cls, {}).values():
+                self.fns[fid].roles.add("http")
+                self.fns[fid].spawn_target = True
+
+    # -------------------------------------------------------- call graph
+
+    def _collect_calls(self) -> None:
+        for fid in list(self.fns) + [None]:
+            self.calls.setdefault(fid, set())
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            caller = self._enclosing_fn(node)
+            callee: Optional[int] = None
+            if isinstance(node.func, ast.Name):
+                callee = self._resolve_fn_name(node.func.id, caller)
+            elif isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name):
+                base = node.func.value.id
+                if base == "self" and caller is not None and \
+                        self.fns[caller].cls is not None:
+                    callee = self.methods.get(
+                        self.fns[caller].cls, {}).get(node.func.attr)
+                elif caller is not None:
+                    tcls = self._typed_class(base, caller)
+                    if tcls is not None:
+                        callee = self.methods.get(tcls, {}).get(
+                            node.func.attr)
+            if callee is not None:
+                self.calls.setdefault(caller, set()).add(callee)
+
+    def _typed_class(self, name: str, fn_id: int) -> Optional[str]:
+        """Class of a local/param name, via annotations / constructor
+        assignment, searched up the lexical chain."""
+        fid: Optional[int] = fn_id
+        while fid is not None:
+            info = self.fns[fid]
+            if name in info.typed and info.typed[name] in self.classes:
+                return info.typed[name]
+            if name in info.bound:
+                return None
+            fid = info.parent
+        return None
+
+    def _propagate_roles(self) -> None:
+        # incoming-edge count: entry points (no module-local caller, not
+        # a spawn target, not a handler method) run on the main thread
+        called: Set[int] = set()
+        for callees in self.calls.values():
+            called.update(callees)
+        for fid, info in self.fns.items():
+            if info.spawn_target:
+                continue
+            if fid not in called or None in [
+                    c for c, callees in self.calls.items()
+                    if fid in callees]:
+                info.roles.add("main")
+        changed = True
+        while changed:
+            changed = False
+            for caller, callees in self.calls.items():
+                roles = (self.fns[caller].roles if caller is not None
+                         else {"main"})
+                for callee in callees:
+                    info = self.fns[callee]
+                    if not roles <= info.roles:
+                        info.roles |= roles
+                        changed = True
+        for info in self.fns.values():
+            if not info.roles:
+                info.roles.add("main")
+
+    # ------------------------------------------------ closure shared sets
+
+    def _collect_closure_shared(self) -> None:
+        """For every scope F that spawns a nested function G: the names
+        free in G (and its descendants) that are bound in F are shared
+        state between role(F) and role(G)."""
+        for fid, info in self.fns.items():
+            if not info.spawn_target or info.parent is None:
+                continue
+            free = self._free_names(fid)
+            anc = info.parent
+            remaining = set(free)
+            while anc is not None and remaining:
+                a = self.fns[anc]
+                hit = remaining & a.bound
+                a.shared |= hit
+                remaining -= hit
+                anc = a.parent
+
+    def _free_names(self, fid: int) -> Set[str]:
+        info = self.fns[fid]
+        free: Set[str] = set(info.nonlocals)
+        for n in ast.walk(info.node):
+            if isinstance(n, ast.Name) and n.id not in info.bound and \
+                    n.id not in info.globals_decl:
+                free.add(n.id)
+        return free
+
+    # --------------------------------------------- pass 2: held-lock walk
+
+    def scan(self) -> None:
+        for fid, info in self.fns.items():
+            body = getattr(info.node, "body", None)
+            if isinstance(body, list):
+                self._walk_block(body, frozenset(), info)
+            else:                                  # spawned lambda body
+                self._scan_expr(info.node.body, frozenset(), info)
+        # module level: role main, pre-thread by definition
+        self._walk_block(
+            [s for s in self.tree.body
+             if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef))],
+            frozenset(), None)
+
+    def _lock_id(self, expr: ast.expr,
+                 info: Optional[_FnInfo]) -> Optional[str]:
+        """Resolve a ``with X`` / ``X.acquire()`` receiver to a known
+        lock identity (None when X isn't a trackable lock)."""
+        if isinstance(expr, ast.Name):
+            fid = id(info.node) if info is not None else None
+            while fid is not None:
+                f = self.fns[fid]
+                if expr.id in f.local_locks:
+                    return f.local_locks[expr.id]
+                if expr.id in f.bound:
+                    return None
+                fid = f.parent
+            return self.global_locks.get(expr.id)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            base = expr.value.id
+            if base == "self" and info is not None and \
+                    info.cls is not None:
+                return self.lock_attrs.get((info.cls, expr.attr))
+            if info is not None:
+                tcls = self._typed_class(base, id(info.node))
+                if tcls is not None:
+                    return self.lock_attrs.get((tcls, expr.attr))
+        return None
+
+    def _wd_id(self, expr: ast.expr,
+               info: Optional[_FnInfo]) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            fid = id(info.node) if info is not None else None
+            while fid is not None:
+                f = self.fns[fid]
+                if expr.id in f.local_wds:
+                    return f.local_wds[expr.id]
+                if expr.id in f.bound:
+                    return None
+                fid = f.parent
+            return self.global_wds.get(expr.id)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            base = expr.value.id
+            if base == "self" and info is not None and \
+                    info.cls is not None:
+                return self.wd_attrs.get((info.cls, expr.attr))
+            if info is not None:
+                tcls = self._typed_class(base, id(info.node))
+                if tcls is not None:
+                    return self.wd_attrs.get((tcls, expr.attr))
+        return None
+
+    def _roles_of(self, info: Optional[_FnInfo]) -> frozenset:
+        return frozenset(info.roles) if info is not None \
+            else frozenset({"main"})
+
+    def _record_acquire(self, lock: str, held: frozenset,
+                        node: ast.AST, info: Optional[_FnInfo]) -> None:
+        self.acquires.append(_Acquire(lock=lock, roles=self._roles_of(info),
+                                      held=held, node=node))
+
+    def _walk_block(self, stmts: Sequence[ast.stmt], held: frozenset,
+                    info: Optional[_FnInfo]) -> frozenset:
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue                       # own scan pass
+            if isinstance(s, (ast.With, ast.AsyncWith)):
+                newly: Set[str] = set()
+                for item in s.items:
+                    self._scan_expr(item.context_expr, held, info)
+                    lid = self._lock_id(item.context_expr, info)
+                    if lid is not None:
+                        self._record_acquire(lid, held | newly,
+                                             item.context_expr, info)
+                        newly.add(lid)
+                self._walk_block(s.body, held | newly, info)
+                continue
+            if isinstance(s, ast.If):
+                self._scan_expr(s.test, held, info)
+                guard = self._acquire_in_test(s.test, info)
+                body_held = held | ({guard[0]} if guard else set())
+                if guard:
+                    self._record_acquire(guard[0], held, guard[1], info)
+                self._walk_block(s.body, body_held, info)
+                self._walk_block(s.orelse, held, info)
+                continue
+            if isinstance(s, (ast.For, ast.AsyncFor)):
+                self._scan_expr(s.iter, held, info)
+                self._walk_block(s.body, held, info)
+                self._walk_block(s.orelse, held, info)
+                continue
+            if isinstance(s, ast.While):
+                self._scan_expr(s.test, held, info)
+                self._walk_block(s.body, held, info)
+                self._walk_block(s.orelse, held, info)
+                continue
+            if isinstance(s, ast.Try):
+                self._walk_block(s.body, held, info)
+                for h in s.handlers:
+                    self._walk_block(h.body, held, info)
+                self._walk_block(s.orelse, held, info)
+                self._walk_block(s.finalbody, held, info)
+                continue
+            # simple statement: scan expressions, then track explicit
+            # acquire/release transitions for subsequent statements
+            for e in self._stmt_exprs(s):
+                self._scan_expr(e, held, info)
+            held = self._transition(s, held, info)
+        return held
+
+    @staticmethod
+    def _stmt_exprs(s: ast.stmt) -> List[ast.expr]:
+        out: List[ast.expr] = []
+        for field in ("value", "test", "msg", "exc", "cause"):
+            v = getattr(s, field, None)
+            if isinstance(v, ast.expr):
+                out.append(v)
+        for field in ("targets",):
+            for v in getattr(s, field, []) or []:
+                if isinstance(v, ast.expr):
+                    out.append(v)
+        v = getattr(s, "target", None)
+        if isinstance(v, ast.expr):
+            out.append(v)
+        return out
+
+    def _acquire_in_test(self, test: ast.expr, info: Optional[_FnInfo]
+                         ) -> Optional[Tuple[str, ast.AST]]:
+        """``if lock.acquire(timeout=...):`` — the body runs with the
+        lock held (the bounded-acquire idiom the repo standardizes on)."""
+        for n in ast.walk(test):
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "acquire":
+                lid = self._lock_id(n.func.value, info)
+                if lid is not None:
+                    return lid, n
+        return None
+
+    def _transition(self, s: ast.stmt, held: frozenset,
+                    info: Optional[_FnInfo]) -> frozenset:
+        call: Optional[ast.Call] = None
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Call):
+            call = s.value
+        elif isinstance(s, ast.Assign) and isinstance(s.value, ast.Call):
+            call = s.value
+        if call is None or not isinstance(call.func, ast.Attribute):
+            return held
+        if call.func.attr == "acquire":
+            lid = self._lock_id(call.func.value, info)
+            if lid is not None:
+                self._record_acquire(lid, held, call, info)
+                return held | {lid}
+        elif call.func.attr == "release":
+            lid = self._lock_id(call.func.value, info)
+            if lid is not None:
+                return held - {lid}
+        return held
+
+    # ------------------------------------------------- expression scan
+
+    def _scan_expr(self, expr: ast.expr, held: frozenset,
+                   info: Optional[_FnInfo]) -> None:
+        parents: Dict[int, ast.AST] = {}
+        nodes: List[ast.AST] = []
+
+        def walk(n: ast.AST) -> None:
+            nodes.append(n)
+            for c in ast.iter_child_nodes(n):
+                if isinstance(c, ast.Lambda) and id(c) in self.fns:
+                    continue           # spawned lambda: its own scope
+                parents[id(c)] = n
+                walk(c)
+
+        walk(expr)
+        roles = self._roles_of(info)
+        for n in nodes:
+            if isinstance(n, ast.Call):
+                self._scan_call(n, held, info, roles)
+            if isinstance(n, ast.Attribute):
+                self._scan_attr_access(n, parents, held, info, roles)
+            elif isinstance(n, ast.Name):
+                self._scan_name_access(n, parents, held, info, roles)
+
+    def _scan_call(self, call: ast.Call, held: frozenset,
+                   info: Optional[_FnInfo], roles: frozenset) -> None:
+        name = self.canonical(call.func)
+        attr = call.func.attr if isinstance(call.func, ast.Attribute) \
+            else None
+        # GT102: unbounded acquire anywhere a thread topology exists
+        if attr == "acquire" and not _is_bounded_acquire(call):
+            lid = self._lock_id(call.func.value, info)
+            if self.has_spawns or lid is not None:
+                where = f" on `{lid}`" if lid else ""
+                self.emit(
+                    call, "GT102",
+                    f"bare `.acquire()`{where} without a timeout in "
+                    f"threaded code (role(s) "
+                    f"{', '.join(sorted(roles))}): a stuck holder "
+                    f"wedges this thread with no watchdog escape — "
+                    f"use `acquire(timeout=...)` and handle the False "
+                    f"return, or `with lock:` for short sections")
+        # GT105 stamp census
+        if attr in _STAMP_METHODS and \
+                isinstance(call.func, ast.Attribute):
+            wid = self._wd_id(call.func.value, info)
+            if wid is not None:
+                self._wd_stamps.setdefault(wid, []).append(
+                    (roles, call, info.qualname if info else "<module>"))
+        # GT106 blocking-call census (classified after contention known)
+        blocking: Optional[str] = None
+        if name in _BLOCKING_NAMES:
+            blocking = name
+        elif attr in ("device_get", "block_until_ready"):
+            blocking = attr
+        elif attr in _BLOCKING_SOCKET_ATTRS:
+            blocking = f".{attr}()"
+        elif attr in _BLOCKING_IF_UNBOUNDED and not _has_timeout(call):
+            # cond.wait() while holding cond RELEASES it — the one
+            # sanctioned blocking-under-lock idiom, never flagged
+            lid = self._lock_id(call.func.value, info) \
+                if isinstance(call.func, ast.Attribute) else None
+            if lid is None or lid not in held:
+                blocking = f".{attr}()"
+        if blocking is not None and held:
+            self.blockings.append(_Blocking(what=blocking, roles=roles,
+                                            held=held, node=call))
+
+    # census access recording -------------------------------------------
+
+    def _access_kind(self, n: ast.AST,
+                     parents: Dict[int, ast.AST]) -> Optional[bool]:
+        """True = write, False = read, None = not a state access (a
+        plain method call on the object)."""
+        ctx = getattr(n, "ctx", None)
+        if isinstance(ctx, (ast.Store, ast.Del)):
+            return True
+        cur = n
+        while True:
+            p = parents.get(id(cur))
+            if p is None:
+                return False
+            if isinstance(p, ast.Subscript) and p.value is cur:
+                if isinstance(p.ctx, (ast.Store, ast.Del)):
+                    return True
+                cur = p
+                continue
+            if isinstance(p, ast.Attribute) and p.value is cur:
+                gp = parents.get(id(p))
+                if isinstance(gp, ast.Call) and gp.func is p:
+                    if p.attr in _MUTATORS:
+                        return True
+                    return False
+                return False
+            if isinstance(p, ast.AugAssign) and p.target is cur:
+                return True
+            return False
+
+    def _record(self, key: Tuple, write: bool, init: bool,
+                roles: frozenset, held: frozenset, node: ast.AST,
+                info: Optional[_FnInfo]) -> None:
+        self.accesses.append(_Access(
+            key=key, write=write, init=init, roles=roles, held=held,
+            node=node, fn=info.qualname if info else "<module>"))
+
+    def _scan_attr_access(self, n: ast.Attribute,
+                          parents: Dict[int, ast.AST], held: frozenset,
+                          info: Optional[_FnInfo],
+                          roles: frozenset) -> None:
+        if not isinstance(n.value, ast.Name):
+            return
+        base = n.value.id
+        cls: Optional[str] = None
+        if base == "self" and info is not None and info.cls is not None:
+            cls = info.cls
+        elif info is not None:
+            cls = self._typed_class(base, id(info.node))
+        if cls is None:
+            return
+        key2 = (cls, n.attr)
+        if key2 in self.lock_attrs or key2 in self.safe_attrs or \
+                key2 in self.wd_attrs:
+            return
+        # skip method references: calls resolve through the call graph
+        if n.attr in self.methods.get(cls, {}):
+            return
+        kind = self._access_kind(n, parents)
+        if kind is None:
+            return
+        init = (info is not None and info.cls == cls
+                and info.name in _INIT_METHODS)
+        self._record(("attr", cls, n.attr), kind, init, roles, held,
+                     n, info)
+
+    def _scan_name_access(self, n: ast.Name,
+                          parents: Dict[int, ast.AST], held: frozenset,
+                          info: Optional[_FnInfo],
+                          roles: frozenset) -> None:
+        name = n.id
+        # closure census: resolve to the nearest enclosing binder; if
+        # that scope shares the name with a spawned nested fn, census it
+        if info is not None:
+            fid: Optional[int] = id(info.node)
+            while fid is not None:
+                f = self.fns[fid]
+                if name in f.bound and name not in f.nonlocals:
+                    if name in f.shared and \
+                            name not in f.local_locks and \
+                            name not in f.local_safe and \
+                            name not in f.local_wds:
+                        kind = self._access_kind(n, parents)
+                        if kind is None:
+                            return
+                        # pre-spawn accesses in the owning scope
+                        # happen-before the thread exists
+                        init = (id(info.node) == fid
+                                and f.first_spawn_line is not None
+                                and n.lineno < f.first_spawn_line)
+                        self._record(("closure", f.qualname, name),
+                                     kind, init, roles, held, n, info)
+                    return
+                fid = f.parent
+        # module-global census: only names some function writes via
+        # ``global`` (read-only module constants are not shared state)
+        if name in self.written_globals and \
+                name not in self.global_locks and \
+                name not in self.global_safe and \
+                name not in self.global_wds:
+            kind = self._access_kind(n, parents)
+            if kind is None:
+                return
+            self._record(("global", self.path, name), kind,
+                         info is None, roles, held, n, info)
+
+    # ----------------------------------------------- pass 3: classify
+
+    def classify(self) -> None:
+        self._classify_census()
+        self._classify_cycles()
+        self._classify_watchdogs()
+        self._classify_blocking()
+
+    @staticmethod
+    def _describe(key: Tuple) -> str:
+        kind, owner, name = (key + ("",))[:3]
+        if kind == "attr":
+            return f"`self.{name}` ({owner})"
+        if kind == "closure":
+            return f"closure var `{name}` (in {owner})"
+        return f"module global `{name}`"
+
+    def _classify_census(self) -> None:
+        by_key: Dict[Tuple, List[_Access]] = {}
+        for a in self.accesses:
+            by_key.setdefault(a.key, []).append(a)
+        for key, sites in sorted(by_key.items(),
+                                 key=lambda kv: str(kv[0])):
+            live = [s for s in sites if not s.init]
+            if not live:
+                continue
+            role_union: Set[str] = set()
+            for s in live:
+                role_union |= set(s.roles)
+            if len(role_union) < 2:
+                continue
+            writes = [s for s in live if s.write]
+            if not writes:
+                continue
+            locked = [s for s in live if s.held]
+            desc = self._describe(key)
+            rs = ", ".join(sorted(role_union))
+            if not locked:
+                for w in writes:
+                    self.emit(
+                        w.node, "GT101",
+                        f"{desc} is written here and accessed from "
+                        f"role(s) {rs} with no lock at any site — "
+                        f"cross-thread data race; guard every access "
+                        f"with one lock (or baseline with a "
+                        f"justification for why this is safe)")
+                continue
+            common = frozenset.intersection(*[s.held for s in live]) \
+                if all(s.held for s in live) else frozenset()
+            if common:
+                continue                        # uniformly protected
+            lock_counts: Dict[str, int] = {}
+            for s in locked:
+                for lid in s.held:
+                    lock_counts[lid] = lock_counts.get(lid, 0) + 1
+            dominant = max(sorted(lock_counts), key=lock_counts.get)
+            for s in live:
+                if dominant not in s.held:
+                    self.emit(
+                        s.node, "GT103",
+                        f"{desc} is {'written' if s.write else 'read'} "
+                        f"here without `{dominant}` but "
+                        f"{lock_counts[dominant]} other site(s) hold "
+                        f"it (roles {rs}) — the lock protects nothing "
+                        f"unless every cross-thread access takes it")
+
+    def _classify_cycles(self) -> None:
+        edges: Dict[str, Set[str]] = {}
+        sites: Dict[Tuple[str, str], ast.AST] = {}
+        for a in self.acquires:
+            for h in a.held:
+                if h == a.lock:
+                    continue                   # RLock re-entry, not ABBA
+                edges.setdefault(h, set()).add(a.lock)
+                sites.setdefault((h, a.lock), a.node)
+
+        def reaches(src: str, dst: str) -> bool:
+            seen: Set[str] = set()
+            stack = [src]
+            while stack:
+                cur = stack.pop()
+                if cur == dst:
+                    return True
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                stack.extend(edges.get(cur, ()))
+            return False
+
+        for (a, b), node in sorted(sites.items()):
+            if reaches(b, a):
+                self.emit(
+                    node, "GT104",
+                    f"lock-ordering cycle: `{b}` is acquired here "
+                    f"while `{a}` is held, but elsewhere the order is "
+                    f"reversed — two threads taking the ends in "
+                    f"opposite order deadlock; pick one global order")
+
+    def _classify_watchdogs(self) -> None:
+        for wid, stamps in sorted(self._wd_stamps.items()):
+            role_union: Set[str] = set()
+            for roles, _, _ in stamps:
+                role_union |= set(roles)
+            if len(role_union) < 2:
+                continue
+            rs = ", ".join(sorted(role_union))
+            for roles, node, fn in stamps:
+                self.emit(
+                    node, "GT105",
+                    f"Watchdog `{wid}` is stamped from role(s) {rs} "
+                    f"(here: {fn}) — interleaved stamps mask a stall "
+                    f"in either thread behind the other's heartbeat; "
+                    f"give each thread its own Watchdog (the Sebulba "
+                    f"per-thread-watchdog discipline)")
+
+    def _classify_blocking(self) -> None:
+        contention: Dict[str, Set[str]] = {}
+        for a in self.acquires:
+            contention.setdefault(a.lock, set()).update(a.roles)
+        for b in self.blockings:
+            contended = [l for l in sorted(b.held)
+                         if len(contention.get(l, set())) >= 2]
+            if not contended:
+                continue
+            lock = contended[0]
+            others = ", ".join(sorted(contention[lock] - set(b.roles))
+                               or sorted(contention[lock]))
+            self.emit(
+                b.node, "GT106",
+                f"blocking call `{b.what}` while holding `{lock}`, "
+                f"which role(s) {others} also acquire — a device/"
+                f"socket stall here wedges every contender and no "
+                f"watchdog can preempt a held lock; move the blocking "
+                f"call outside the critical section")
+
+    # ------------------------------------------------------------- drive
+
+    def run(self) -> List[Finding]:
+        if any(_SKIP_FILE_RE.search(l) for l in self.lines[:10]):
+            return []
+        self._wd_stamps: Dict[str, List[Tuple[frozenset, ast.AST,
+                                              str]]] = {}
+        self.build()
+        self.scan()
+        self.classify()
+        return sorted(self.findings,
+                      key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+# ---------------------------------------------------------------- frontend
+
+def trace_source(src: str, path: str = "<memory>") -> List[Finding]:
+    """Audit one source string (fixture entry point for the tests)."""
+    return _ModuleTracer(src, path).run()
+
+
+def trace_file(path: Path, root: Path) -> List[Finding]:
+    rel = path.resolve().relative_to(root.resolve()).as_posix()
+    return trace_source(path.read_text(), rel)
+
+
+def trace_package(root: Path,
+                  paths: Optional[Sequence[Path]] = None
+                  ) -> List[Finding]:
+    """Audit every ``*.py`` under ``paths`` (default:
+    ``root/t2omca_tpu``), reporting paths relative to ``root``."""
+    root = Path(root)
+    if paths is None:
+        paths = [root / "t2omca_tpu"]
+    findings: List[Finding] = []
+    for p in paths:
+        p = Path(p)
+        files: Iterable[Path] = (sorted(p.rglob("*.py")) if p.is_dir()
+                                 else [p])
+        for f in files:
+            findings.extend(trace_file(f, root))
+    return findings

@@ -2566,8 +2566,8 @@ def run_sebulba(exp: Experiment, logger: Logger, results_dir: str,
         nonlocal last_log_t, last_save_t, last_log_time
         nonlocal stop_at, stop_ok
         stop_event.clear()
-        actor_failure.clear()
         with cond:
+            actor_failure.clear()   # same discipline as its append sites
             counters.update(put=0, got=0, consumed=0, started=0)
             cell["q"] = seb.init_queue()
             cell["rs"], cell["rs_t_env"] = rs, t_env0

@@ -307,7 +307,9 @@ class _Engine:
         self.wd: Optional[Watchdog] = None
         self.lock = threading.Lock()
         self.current: Optional[Tuple[FleetRequest, float]] = None
-        self.pause = False
+        # Event, not a bare bool: set/cleared by the refresh thread,
+        # polled by the worker — Event carries the memory barrier
+        self.pause_ev = threading.Event()
         self.idle = threading.Event()
         self.idle.set()
         self.restart_at = 0.0
@@ -395,7 +397,8 @@ class ServeFleet:
                 self._hub.health(f"fleet_engine{eng.idx}", eng.healthy)
             self._hub.health("fleet", self._fleet_health)
         for eng in self.engines:
-            self._spawn_worker(eng)
+            with eng.lock:              # _spawn_worker's contract
+                self._spawn_worker(eng)
         self._sup = threading.Thread(target=self._supervise, daemon=True,
                                      name="t2omca-fleet-supervisor")
         self._sup.start()
@@ -494,6 +497,9 @@ class ServeFleet:
         return fe
 
     def _spawn_worker(self, eng: _Engine) -> None:
+        """Caller holds ``eng.lock`` (start() and the supervisor both
+        do): the gen bump is a read-modify-write racing the supervisor's
+        stall path, and must not take the plain Lock itself."""
         eng.gen += 1
         gen = eng.gen
         self._set_state(eng, "starting" if eng.restarts == 0
@@ -556,7 +562,7 @@ class ServeFleet:
 
         try:
             while not self._stop_ev.is_set() and eng.gen == gen:
-                if eng.pause:
+                if eng.pause_ev.is_set():
                     eng.idle.set()
                     time.sleep(cfg.poll_s)
                     continue
@@ -565,7 +571,7 @@ class ServeFleet:
                     eng.idle.set()
                     continue
                 eng.idle.clear()
-                if eng.pause:           # pause landed mid-dequeue: the
+                if eng.pause_ev.is_set():  # pause landed mid-dequeue:
                     self._q.put(req, front=True)   # drain must not race
                     eng.idle.set()
                     continue
@@ -1006,7 +1012,7 @@ class ServeFleet:
         """Take one engine out of rotation and wait until it is drained
         (idle, nothing in flight). Two consecutive idle observations a
         poll apart close the dequeue→idle.clear() race window."""
-        eng.pause = True
+        eng.pause_ev.set()
         deadline = time.monotonic() + timeout_s
         quiet = 0
         while time.monotonic() < deadline:
@@ -1019,11 +1025,11 @@ class ServeFleet:
             else:
                 quiet = 0
             time.sleep(self.cfg.poll_s)
-        eng.pause = False
+        eng.pause_ev.clear()
         return False
 
     def _resume(self, eng: _Engine) -> None:
-        eng.pause = False
+        eng.pause_ev.clear()
 
     def _rollback(self, swapped: List[Tuple[_Engine, object]]) -> None:
         """Restore every already-swapped engine's old params (reverse

@@ -178,7 +178,7 @@ def test_fleet_select_ok_and_hidden_carry():
 def test_fleet_sheds_past_queue_bound_never_blocks():
     with _mk_fleet(n=2, cfg=_cfg(queue_depth=2)) as fleet:
         for e in fleet.engines:
-            e.pause = True
+            e.pause_ev.set()
         admitted = [fleet.submit(*_req()) for _ in range(2)]
         t0 = time.monotonic()
         shed = fleet.submit(*_req())
@@ -187,14 +187,14 @@ def test_fleet_sheds_past_queue_bound_never_blocks():
         assert shed.result.status == "shed"
         assert "queue full" in shed.result.error
         for e in fleet.engines:
-            e.pause = False
+            e.pause_ev.clear()
         assert all(r.wait(5.0).ok for r in admitted)
         assert fleet.stats()["fleet_shed_total"] == 1
 
 
 def test_fleet_deadline_resolves_even_with_all_engines_paused():
     with _mk_fleet(n=1) as fleet:
-        fleet.engines[0].pause = True            # nothing will dispatch
+        fleet.engines[0].pause_ev.set()            # nothing will dispatch
         t0 = time.monotonic()
         r = fleet.select(*_req(), deadline_s=0.3)
         assert r.status == "deadline"
@@ -233,7 +233,7 @@ def test_fleet_crash_quarantines_bounces_and_rejoins():
 
     resilience.register_fault("fleet.dispatch", killer)
     with _mk_fleet(n=2) as fleet:
-        fleet.engines[1].pause = True    # engine 0 must take the request
+        fleet.engines[1].pause_ev.set()    # engine 0 must take the request
         r = fleet.select(*_req(), deadline_s=5.0)
         # the request survived the crash: bounced, re-served after the
         # backoff restart of the only unpaused engine
@@ -258,10 +258,10 @@ def test_fleet_stall_is_hedged_and_stalled_engine_restarts():
     resilience.register_fault("fleet.dispatch", hanger)
     with _mk_fleet(n=2, cfg=_cfg(dispatch_timeout_s=0.3,
                                  deadline_s=5.0)) as fleet:
-        fleet.engines[1].pause = True
+        fleet.engines[1].pause_ev.set()
         req = fleet.submit(*_req())
         assert _until(lambda: hung, timeout=2.0)
-        fleet.engines[1].pause = False          # the hedge target
+        fleet.engines[1].pause_ev.clear()          # the hedge target
         r = req.wait(6.0)
         # the hedge won on the healthy peer LONG before the wedged
         # dispatch would have returned
@@ -448,7 +448,7 @@ def test_fleet_refresh_trigger_file_arms_refresh(tmp_path):
 def test_fleet_stop_resolves_everything_outstanding():
     fleet = _mk_fleet(n=2).start()
     for e in fleet.engines:
-        e.pause = True
+        e.pause_ev.set()
     reqs = [fleet.submit(*_req()) for _ in range(5)]
     fleet.stop()
     for req in reqs:

@@ -1,0 +1,520 @@
+"""graftrace enforcement (t2omca_tpu/analysis/graftrace.py,
+docs/ANALYSIS.md GT catalog): per-rule positive/negative fixtures —
+including replicas of the three historical bugs (Logger.stats race →
+GT101, wedged-exit save_lock acquire → GT102, Sebulba shared watchdog
+stamp → GT105) so the gate provably catches them — plus baseline
+round-trip/ratchet/family-scoping, the zero-new-findings ratchet over
+the real package, and the subprocess CLI exit-code contract."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from t2omca_tpu.analysis import (GT_RULES, diff_baseline, filter_family,
+                                 load_baseline, save_baseline,
+                                 trace_package, trace_source)
+from t2omca_tpu.analysis.graftlint import lint_source
+
+pytestmark = pytest.mark.graftrace
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def rules_of(src, path="fixture.py"):
+    return [f.rule for f in trace_source(src, path)]
+
+
+# ------------------------------------------------- GT101 (Logger race)
+
+LOGGER_RACE = """
+import threading
+
+class Logger:
+    def __init__(self):
+        self.stats = {}
+        self.flusher = threading.Thread(target=self._flush, daemon=True)
+        self.flusher.start()
+
+    def log(self, k, v):
+        self.stats[k] = v            # main-thread write
+
+    def _flush(self):
+        for k in list(self.stats):   # flusher-thread read/pop
+            self.stats.pop(k)
+"""
+
+
+def test_gt101_logger_race_replica():
+    """The historical unsynchronized ``Logger.stats`` race: written from
+    the caller thread, drained from the flusher, no lock anywhere."""
+    fs = trace_source(LOGGER_RACE, "fixture.py")
+    assert [f.rule for f in fs] == ["GT101", "GT101"]
+    assert all("stats" in f.message for f in fs)
+
+
+def test_gt101_negative_locked_everywhere():
+    src = """
+import threading
+
+class Logger:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.stats = {}
+        threading.Thread(target=self._flush, daemon=True).start()
+
+    def log(self, k, v):
+        with self.lock:
+            self.stats[k] = v
+
+    def _flush(self):
+        with self.lock:
+            self.stats.clear()
+"""
+    assert rules_of(src) == []
+
+
+def test_gt101_negative_init_writes_are_pre_thread():
+    """``__init__`` writes happen-before the spawn; a single-role module
+    (no spawns) shares nothing at all."""
+    src = """
+class Plain:
+    def __init__(self):
+        self.stats = {}
+
+    def log(self, k, v):
+        self.stats[k] = v
+"""
+    assert rules_of(src) == []
+
+
+def test_gt101_closure_var_shared_with_spawned_worker():
+    src = """
+import threading
+
+def run():
+    total = 0
+    def worker():
+        nonlocal total
+        total += 1
+    t = threading.Thread(target=worker)
+    t.start()
+    total += 1            # after the spawn: races the worker
+"""
+    fs = trace_source(src, "fixture.py")
+    assert [f.rule for f in fs] == ["GT101", "GT101"]
+    assert all("total" in f.message for f in fs)
+
+
+def test_gt101_closure_writes_before_spawn_exempt():
+    src = """
+import threading
+
+def run():
+    total = 0             # setup: happens-before the spawn
+    def worker():
+        print(total)      # read-only consumer
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(timeout=5.0)
+"""
+    assert rules_of(src) == []
+
+
+# --------------------------------------------- GT102 (save_lock wedge)
+
+SAVE_LOCK_WEDGE = """
+import threading
+
+save_lock = threading.Lock()
+
+def save(state):
+    save_lock.acquire()
+    try:
+        state.flush()
+    finally:
+        save_lock.release()
+"""
+
+
+def test_gt102_unbounded_acquire_replica():
+    """The historical exit wedge: a bare ``save_lock.acquire()`` blocks
+    forever if the holder is stuck — PR 4's bounded-acquire policy,
+    made checkable."""
+    fs = trace_source(SAVE_LOCK_WEDGE, "fixture.py")
+    assert [f.rule for f in fs] == ["GT102"]
+    assert "acquire" in fs[0].code
+
+
+def test_gt102_negative_bounded_or_nonblocking():
+    src = """
+import threading
+
+save_lock = threading.Lock()
+
+def save(state):
+    if not save_lock.acquire(timeout=30.0):
+        raise TimeoutError("save_lock wedged")
+    try:
+        state.flush()
+    finally:
+        save_lock.release()
+
+def try_save(state):
+    if save_lock.acquire(blocking=False):
+        try:
+            state.flush()
+        finally:
+            save_lock.release()
+"""
+    assert rules_of(src) == []
+
+
+# ------------------------------------------------- GT103 (mixed locks)
+
+def test_gt103_mixed_locked_and_unlocked_access():
+    src = """
+import threading
+
+class Counter:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.n = 0
+        threading.Thread(target=self._tick, daemon=True).start()
+
+    def _tick(self):
+        with self.lock:
+            self.n += 1
+
+    def read(self):
+        return self.n          # unlocked: the lock protects nothing
+"""
+    fs = trace_source(src, "fixture.py")
+    assert [f.rule for f in fs] == ["GT103"]
+    assert "self.n" in fs[0].message
+    assert fs[0].code.startswith("return self.n")
+
+
+def test_gt103_negative_uniform_discipline():
+    src = """
+import threading
+
+class Counter:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.n = 0
+        threading.Thread(target=self._tick, daemon=True).start()
+
+    def _tick(self):
+        with self.lock:
+            self.n += 1
+
+    def read(self):
+        with self.lock:
+            return self.n
+"""
+    assert rules_of(src) == []
+
+
+# ------------------------------------------------ GT104 (ABBA deadlock)
+
+def test_gt104_lock_ordering_cycle():
+    src = """
+import threading
+
+a = threading.Lock()
+b = threading.Lock()
+
+def fwd():
+    with a:
+        with b:
+            pass
+
+def rev():
+    with b:
+        with a:
+            pass
+"""
+    fs = trace_source(src, "fixture.py")
+    assert [f.rule for f in fs] == ["GT104", "GT104"]
+
+
+def test_gt104_negative_consistent_order_and_reentry():
+    src = """
+import threading
+
+a = threading.Lock()
+b = threading.Lock()
+r = threading.RLock()
+
+def f1():
+    with a:
+        with b:
+            pass
+
+def f2():
+    with a:
+        with b:
+            pass
+
+def reenter():
+    with r:
+        with r:               # RLock re-entry is not a cycle
+            pass
+"""
+    assert rules_of(src) == []
+
+
+# ------------------------------------------- GT105 (shared wd stamp)
+
+SHARED_STAMP = """
+import threading
+from t2omca_tpu.utils.watchdog import Watchdog
+
+wd = Watchdog()
+
+def actor():
+    while True:
+        wd.stamp("actor.step")
+
+def learner():
+    threading.Thread(target=actor, daemon=True).start()
+    while True:
+        wd.stamp("learner.step")
+"""
+
+
+def test_gt105_shared_watchdog_stamp_replica():
+    """The Sebulba gotcha: actor and learner stamping ONE watchdog mask
+    each other's stalls — each thread needs its own."""
+    fs = trace_source(SHARED_STAMP, "fixture.py")
+    assert "GT105" in [f.rule for f in fs]
+    gt105 = [f for f in fs if f.rule == "GT105"]
+    assert any("wd" in f.message for f in gt105)
+
+
+def test_gt105_negative_per_thread_watchdogs():
+    src = """
+import threading
+from t2omca_tpu.utils.watchdog import Watchdog
+
+wd_actor = Watchdog()
+wd_learner = Watchdog()
+
+def actor():
+    wd_actor.stamp("actor.step")
+
+def learner():
+    threading.Thread(target=actor, daemon=True).start()
+    wd_learner.stamp("learner.step")
+"""
+    assert "GT105" not in rules_of(src)
+
+
+# --------------------------------------- GT106 (blocking under a lock)
+
+def test_gt106_device_sync_under_contended_lock():
+    src = """
+import threading
+import jax
+
+lock = threading.Lock()
+
+def worker():
+    with lock:
+        jax.block_until_ready(0)   # every contender stalls behind it
+
+def driver():
+    threading.Thread(target=worker, daemon=True).start()
+    with lock:
+        pass
+"""
+    fs = trace_source(src, "fixture.py")
+    assert "GT106" in [f.rule for f in fs]
+
+
+def test_gt106_negative_uncontended_or_outside_lock():
+    src = """
+import threading
+import jax
+
+lock = threading.Lock()
+
+def worker():
+    x = jax.block_until_ready(0)   # not holding anything
+    with lock:
+        pass
+
+def driver():
+    threading.Thread(target=worker, daemon=True).start()
+    with lock:
+        pass
+"""
+    assert "GT106" not in rules_of(src)
+
+
+def test_gt106_negative_condition_wait_releases_its_own_lock():
+    src = """
+import threading
+
+cond = threading.Condition()
+
+def worker():
+    with cond:
+        cond.wait(timeout=1.0)    # releases cond while waiting
+
+def driver():
+    threading.Thread(target=worker, daemon=True).start()
+    with cond:
+        cond.notify_all()
+"""
+    assert "GT106" not in rules_of(src)
+
+
+# ---------------------------------------------------------- suppression
+
+def test_inline_suppression_and_skip_file():
+    suppressed = LOGGER_RACE.replace(
+        "self.stats[k] = v            # main-thread write",
+        "self.stats[k] = v  # graftrace: disable=GT101")
+    fs = trace_source(suppressed, "fixture.py")
+    assert [f.rule for f in fs] == ["GT101"]   # only the _flush site
+    skip = "# graftrace: skip-file\n" + LOGGER_RACE
+    assert trace_source(skip, "fixture.py") == []
+    # the graftlint suppression tag does NOT silence graftrace
+    other_tool = LOGGER_RACE.replace(
+        "# main-thread write", "# graftlint: disable=GT101")
+    assert [f.rule for f in trace_source(other_tool, "fixture.py")] \
+        == ["GT101", "GT101"]
+
+
+# ------------------------------------------------------------- baseline
+
+def test_baseline_round_trip_ratchet_and_line_shift(tmp_path):
+    findings = trace_source(LOGGER_RACE, "pkg/mod.py")
+    assert len(findings) == 2
+    bl_path = tmp_path / "baseline.json"
+    save_baseline(bl_path, findings)
+    baseline = load_baseline(bl_path)
+    new, stale = diff_baseline(findings, baseline)
+    assert new == [] and stale == []
+    # identity survives a line shift (keys are code text, not line no.)
+    shifted = "\n# header comment\n" + LOGGER_RACE
+    new, stale = diff_baseline(trace_source(shifted, "pkg/mod.py"),
+                               baseline)
+    assert new == [] and stale == []
+    # a fresh hazard (new code text) exceeds the baseline -> new
+    grown = LOGGER_RACE + """
+    def log2(self, k):
+        self.stats[k] = 1
+"""
+    new, _ = diff_baseline(trace_source(grown, "pkg/mod.py"), baseline)
+    assert len(new) == 1 and new[0].rule == "GT101"
+    # fixing everything leaves stale entries, never a failure
+    new, stale = diff_baseline([], baseline)
+    assert new == [] and len(stale) == 2
+
+
+def test_family_scoped_save_carries_the_other_family(tmp_path):
+    """GL and GT share baseline.json: a --threads --write-baseline must
+    carry the lint entries verbatim (and vice versa)."""
+    bl_path = tmp_path / "baseline.json"
+    gl_src = ("import jax\n@jax.jit\ndef f(x):\n    if x > 0:\n"
+              "        return x\n    return -x\n")
+    gl = lint_source(gl_src, "pkg/traced.py")
+    assert [f.rule for f in gl] == ["GL101"]
+    save_baseline(bl_path, gl)
+    # hand-justify the GL entry, as review would
+    data = json.loads(bl_path.read_text())
+    data["findings"][0]["justification"] = "intentional fixture branch"
+    bl_path.write_text(json.dumps(data))
+    old = load_baseline(bl_path)
+    # a GT-scoped rewrite keeps the GL entry + its justification
+    gt = trace_source(LOGGER_RACE, "pkg/mod.py")
+    save_baseline(bl_path, gt, old, family="GT")
+    merged = load_baseline(bl_path)
+    assert filter_family(merged, "GL") == old
+    assert len(filter_family(merged, "GT")) == 2
+    # and a GL-scoped rewrite keeps the GT entries
+    save_baseline(bl_path, gl, merged, family="GL")
+    again = load_baseline(bl_path)
+    assert filter_family(again, "GT") == filter_family(merged, "GT")
+
+
+# ------------------------------------------------- the real package gate
+
+def test_real_package_zero_new_findings():
+    """The ratchet over t2omca_tpu/ itself: every current GT finding is
+    either fixed or baselined with a justification — new hazards fail
+    here (and in the scripts/t1.sh prelude before the pytest batch)."""
+    findings = trace_package(REPO)
+    baseline = filter_family(load_baseline(), "GT")
+    new, _stale = diff_baseline(findings, baseline)
+    assert new == [], "new graftrace findings:\n" + "\n".join(
+        f.format() for f in new)
+    assert baseline, "the GT baseline should not be empty"
+    for key, entry in baseline.items():
+        assert entry["justification"] and \
+            not entry["justification"].startswith("TODO"), key
+
+
+def test_rule_catalog_documented():
+    doc = (REPO / "docs" / "ANALYSIS.md").read_text()
+    for rule in GT_RULES:
+        assert rule in doc, f"{rule} missing from docs/ANALYSIS.md"
+
+
+# ------------------------------------------------------------------ CLI
+
+def test_cli_exit_codes(tmp_path):
+    env_probe = (
+        "import sys, runpy\n"
+        "sys.argv = ['t2omca_tpu.analysis', '--threads']\n"
+        "try:\n"
+        "    runpy.run_module('t2omca_tpu.analysis', "
+        "run_name='__main__')\n"
+        "except SystemExit as e:\n"
+        "    assert 'jax' not in sys.modules, 'CLI imported jax'\n"
+        "    sys.exit(e.code)\n")
+    r = subprocess.run([sys.executable, "-c", env_probe],
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "graftrace:" in r.stdout
+    # seeded hazard in a scratch tree -> exit 1, finding printed
+    pkg = tmp_path / "t2omca_tpu"
+    pkg.mkdir()
+    (pkg / "seeded.py").write_text(SAVE_LOCK_WEDGE)
+    r = subprocess.run(
+        [sys.executable, "-m", "t2omca_tpu.analysis", "--threads",
+         "--root", str(tmp_path), "--no-baseline", str(pkg)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "GT102" in r.stdout and "t2omca_tpu/seeded.py" in r.stdout
+    # a corrupt baseline is an internal error (2), never "new findings"
+    bad = tmp_path / "bad_baseline.json"
+    bad.write_text('{"version": 99, "findings": []}')
+    r = subprocess.run(
+        [sys.executable, "-m", "t2omca_tpu.analysis", "--threads",
+         "--baseline", str(bad)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2 and "baseline" in r.stderr
+
+
+def test_cli_catches_all_three_historical_replicas(tmp_path):
+    """The acceptance bar: Logger race, shared watchdog stamp, and the
+    unbounded save_lock acquire are each caught in-gate."""
+    pkg = tmp_path / "t2omca_tpu"
+    pkg.mkdir()
+    (pkg / "logger_race.py").write_text(LOGGER_RACE)
+    (pkg / "save_wedge.py").write_text(SAVE_LOCK_WEDGE)
+    (pkg / "shared_stamp.py").write_text(SHARED_STAMP)
+    r = subprocess.run(
+        [sys.executable, "-m", "t2omca_tpu.analysis", "--threads",
+         "--root", str(tmp_path), "--no-baseline", str(pkg)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    for rule in ("GT101", "GT102", "GT105"):
+        assert rule in r.stdout, f"{rule} not caught in-gate"
